@@ -1,0 +1,34 @@
+"""jepsen_tpu — a TPU-native distributed-systems-testing framework.
+
+A ground-up redesign of the capabilities of Jepsen (reference:
+/root/reference, jepsen/src/jepsen/core.clj) for the JAX/XLA/TPU era:
+
+- the *control plane* (cluster provisioning, fault injection, concurrent
+  workload execution) is host-side Python with pluggable remote backends;
+- the *data plane* is a flat structure-of-arrays int64 tensor encoding of
+  operation histories, shared between the engine, the store, and the
+  checkers;
+- the *analysis plane* runs on TPU: consistency checkers are jitted /
+  vmapped kernels, and the Wing-Gong-Lowe linearizability search (the
+  knossos equivalent) is a bitmask-DFS kernel with its memo cache in HBM,
+  sharded over independent keys via a jax.sharding.Mesh.
+
+Top-level namespaces mirror the reference's layer map (SURVEY.md SS1):
+
+    history     op records + invoke/complete pairing   (knossos.history)
+    models      consistency models as step functions   (knossos.model)
+    checker     Checker protocol + built-in checkers   (jepsen.checker)
+    ops         TPU kernels (WGL search, scans)        (knossos.wgl/linear)
+    generator   op-scheduling DSL                      (jepsen.generator)
+    independent key-space sharding                     (jepsen.independent)
+    client      Client protocol                        (jepsen.client)
+    core        test orchestration / run()             (jepsen.core)
+    control     remote execution                       (jepsen.control)
+    nemesis     fault injection                        (jepsen.nemesis)
+    net         network partitions / degradation       (jepsen.net)
+    db, osenv   node lifecycle                         (jepsen.db, jepsen.os)
+    store       persistence & reporting                (jepsen.store)
+    cli         command-line runners                   (jepsen.cli)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,125 @@
+"""Checker protocol and combinators (reference: jepsen.checker,
+checker.clj:49-108).
+
+A checker validates a recorded history. `check(test, history, opts)`
+returns a dict with at least {"valid": True | False | "unknown"}.
+Exceptions become {"valid": "unknown", "error": ...} via check_safe;
+compose() runs a map of checkers (in parallel threads) and merges their
+validities with false > unknown > true dominance (checker.clj:26-47).
+
+test is the test map (jepsen's immutable test map, core.clj:540-560);
+opts may carry {"subdirectory": ...} for file-writing checkers.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Mapping
+
+from ..util import bounded_pmap
+
+VALID_PRIORITIES = {True: 0, "unknown": 0.5, False: 1}
+
+
+def merge_valid(valids) -> Any:
+    """The highest-priority validity: any False wins, else any "unknown",
+    else True (checker.clj:33-47)."""
+    out = True
+    for v in valids:
+        if v not in VALID_PRIORITIES:
+            raise ValueError(f"{v!r} is not a known valid value")
+        if VALID_PRIORITIES[v] > VALID_PRIORITIES[out]:
+            out = v
+    return out
+
+
+class Checker:
+    def check(self, test: Mapping, history, opts: Mapping | None = None) -> dict:
+        raise NotImplementedError
+
+
+def check_safe(checker: Checker, test, history, opts=None) -> dict:
+    """check(), but exceptions are wrapped as unknown verdicts
+    (checker.clj:66-77)."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception:  # noqa: BLE001
+        return {"valid": "unknown", "error": traceback.format_exc()}
+
+
+class Compose(Checker):
+    """Runs a name->checker map in parallel; result maps each name to its
+    sub-result plus a merged top-level "valid" (checker.clj:79-91)."""
+
+    def __init__(self, checker_map: Mapping[str, Checker]):
+        self.checker_map = dict(checker_map)
+
+    def check(self, test, history, opts=None) -> dict:
+        items = list(self.checker_map.items())
+        results = bounded_pmap(
+            lambda kv: (kv[0], check_safe(kv[1], test, history, opts)), items
+        )
+        out = dict(results)
+        out["valid"] = merge_valid(r["valid"] for _, r in results)
+        return out
+
+
+def compose(checker_map) -> Compose:
+    return Compose(checker_map)
+
+
+class ConcurrencyLimit(Checker):
+    """Bounds concurrent executions of a memory-hungry checker with a
+    semaphore (checker.clj:93-108)."""
+
+    def __init__(self, limit: int, checker: Checker):
+        self.sem = threading.Semaphore(limit)
+        self.checker = checker
+
+    def check(self, test, history, opts=None) -> dict:
+        with self.sem:
+            return self.checker.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, checker: Checker) -> ConcurrencyLimit:
+    return ConcurrencyLimit(limit, checker)
+
+
+class UnbridledOptimism(Checker):
+    """Everything is awesoooommmmme! (checker.clj:110-114)"""
+
+    def check(self, test, history, opts=None) -> dict:
+        return {"valid": True}
+
+
+def unbridled_optimism() -> UnbridledOptimism:
+    return UnbridledOptimism()
+
+
+# Re-exports of the concrete checkers
+from .basic import (  # noqa: E402
+    counter,
+    queue,
+    set_checker,
+    set_full,
+    total_queue,
+    unique_ids,
+)
+from .linearizable import linearizable  # noqa: E402
+
+__all__ = [
+    "Checker",
+    "check_safe",
+    "compose",
+    "concurrency_limit",
+    "counter",
+    "linearizable",
+    "merge_valid",
+    "queue",
+    "set_checker",
+    "set_full",
+    "total_queue",
+    "unbridled_optimism",
+    "unique_ids",
+]

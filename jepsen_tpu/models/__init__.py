@@ -1,0 +1,200 @@
+"""Consistency models as pure state-transition functions.
+
+Parity target: knossos.model (SURVEY.md SS2.2) — `(step model op)` returns
+either a new model state or an `Inconsistent`. Here a model is an immutable
+object with `step(f, value) -> Model | Inconsistent`; `value` follows the
+completed-op convention (a read's value is the value it RETURNED, or None
+if unknown).
+
+Every model also declares its *tensor encoding* — how its state packs into
+an int32 and how its step function is expressed branchlessly — via
+`models.jit`, which is what the TPU search kernel compiles. The host
+objects are the semantics oracle; the jitted encodings are tested for
+equivalence against them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Hashable
+
+
+@dataclass(frozen=True)
+class Inconsistent:
+    """A model transition that cannot happen (knossos.model/inconsistent)."""
+
+    msg: str
+
+
+def inconsistent(x: Any) -> bool:
+    """knossos.model/inconsistent? parity."""
+    return isinstance(x, Inconsistent)
+
+
+class Model:
+    """Base for all models. Subclasses must be immutable and hashable —
+    the search memoizes on (linearized-bitset, model-state) pairs
+    (knossos.wgl; SURVEY.md SS2.2)."""
+
+    def step(self, f, value):  # -> Model | Inconsistent
+        raise NotImplementedError
+
+    def step_op(self, op):
+        """Step with an Op or op dict."""
+        from ..history import op as to_op
+
+        o = to_op(op)
+        return self.step(o.f, o.value)
+
+
+@dataclass(frozen=True)
+class NoOp(Model):
+    """Every operation is fine (knossos.model/noop)."""
+
+    def step(self, f, value):
+        return self
+
+
+@dataclass(frozen=True)
+class Register(Model):
+    """A read/write register (knossos.model/register). value None = unset."""
+
+    value: Any = None
+
+    def step(self, f, value):
+        if f == "write":
+            return Register(value)
+        if f == "read":
+            if value is None or value == self.value:
+                return self
+            return Inconsistent(
+                f"read {value!r} from register holding {self.value!r}"
+            )
+        return Inconsistent(f"unknown op {f!r}")
+
+
+@dataclass(frozen=True)
+class CASRegister(Model):
+    """A compare-and-set register (knossos.model/cas-register): the model
+    the north-star search kernel steps (checker.clj:116-141 via
+    tests/linearizable_register.clj:35)."""
+
+    value: Any = None
+
+    def step(self, f, value):
+        if f == "write":
+            return CASRegister(value)
+        if f == "cas":
+            if value is None:
+                return Inconsistent("cas with unknown arguments")
+            old, new = value
+            if self.value == old:
+                return CASRegister(new)
+            return Inconsistent(f"can't CAS {self.value!r} from {old!r} to {new!r}")
+        if f == "read":
+            if value is None or value == self.value:
+                return self
+            return Inconsistent(
+                f"can't read {value!r} from register holding {self.value!r}"
+            )
+        return Inconsistent(f"unknown op {f!r}")
+
+
+@dataclass(frozen=True)
+class Mutex(Model):
+    """A lock (knossos.model/mutex)."""
+
+    locked: bool = False
+
+    def step(self, f, value):
+        if f == "acquire":
+            if self.locked:
+                return Inconsistent("cannot acquire a held lock")
+            return Mutex(True)
+        if f == "release":
+            if not self.locked:
+                return Inconsistent("cannot release a free lock")
+            return Mutex(False)
+        return Inconsistent(f"unknown op {f!r}")
+
+
+def _freeze_multiset(items) -> tuple:
+    return tuple(sorted(items))
+
+
+@dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """A queue where dequeues may come back in any order
+    (knossos.model/unordered-queue). State is a frozen multiset."""
+
+    pending: tuple = ()
+
+    def step(self, f, value):
+        if f == "enqueue":
+            return UnorderedQueue(_freeze_multiset(self.pending + (value,)))
+        if f == "dequeue":
+            if value in self.pending:
+                items = list(self.pending)
+                items.remove(value)
+                return UnorderedQueue(_freeze_multiset(items))
+            return Inconsistent(f"can't dequeue {value!r}")
+        return Inconsistent(f"unknown op {f!r}")
+
+
+@dataclass(frozen=True)
+class FIFOQueue(Model):
+    """A strictly-ordered queue (knossos.model/fifo-queue)."""
+
+    items: tuple = ()
+
+    def step(self, f, value):
+        if f == "enqueue":
+            return FIFOQueue(self.items + (value,))
+        if f == "dequeue":
+            if self.items and self.items[0] == value:
+                return FIFOQueue(self.items[1:])
+            head = self.items[0] if self.items else None
+            return Inconsistent(f"expected dequeue of {head!r}, got {value!r}")
+        return Inconsistent(f"unknown op {f!r}")
+
+
+@dataclass(frozen=True)
+class GrowOnlySet(Model):
+    """A set supporting add and read-everything (knossos model/set shape;
+    used by set workloads)."""
+
+    items: frozenset = frozenset()
+
+    def step(self, f, value):
+        if f == "add":
+            return GrowOnlySet(self.items | {value})
+        if f == "read":
+            if value is None or frozenset(value) == self.items:
+                return self
+            return Inconsistent(f"read {value!r} but set is {sorted(self.items)!r}")
+        return Inconsistent(f"unknown op {f!r}")
+
+
+# convenience constructors mirroring knossos.model's lowercase fns
+def noop() -> NoOp:
+    return NoOp()
+
+
+def register(value=None) -> Register:
+    return Register(value)
+
+
+def cas_register(value=None) -> CASRegister:
+    return CASRegister(value)
+
+
+def mutex() -> Mutex:
+    return Mutex()
+
+
+def unordered_queue() -> UnorderedQueue:
+    return UnorderedQueue()
+
+
+def fifo_queue() -> FIFOQueue:
+    return FIFOQueue()

@@ -1,0 +1,130 @@
+"""Int-encoded, jit-compilable model step functions.
+
+The TPU linearizability search (ops/wgl.py) can't step Python objects: it
+needs the model as a branchless int32 transition function
+
+    step(state: int32, f: int32, v1: int32, v2: int32) -> (state', ok: bool)
+
+compiled straight into the search kernel (BASELINE.json north star: "the
+knossos.model state-transition function JIT-compiled"). Each `JitModel`
+packs a host model's state into an int32 scalar and mirrors its semantics
+exactly; tests/test_models.py checks equivalence against the host oracle
+in jepsen_tpu.models.
+
+Value sentinel: NIL32 marks "unknown/absent" (a crashed read's value, an
+unset register). Payload values must fit in int32 and stay below NIL32 —
+the encoder in ops/wgl.py enforces this and falls back to the host search
+otherwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+NIL32 = np.int32(2**30)
+
+
+@dataclass(frozen=True)
+class JitModel:
+    """A model expressed as an int32 transition function.
+
+    fs: f-name -> code mapping used by the encoder (must match the
+    workload's FSchema ordering).
+    """
+
+    name: str
+    fs: tuple
+    init_state: int
+    step: Callable  # (state, f, v1, v2) -> (state', ok)
+
+    def f_code(self, f) -> int:
+        return self.fs.index(f)
+
+
+def _cas_register_step(state, f, v1, v2):
+    # f: 0=read 1=write 2=cas  (REGISTER_SCHEMA order)
+    is_read = f == 0
+    is_write = f == 1
+    is_cas = f == 2
+    match = state == v1
+    ok = jnp.where(
+        is_read,
+        (v1 == NIL32) | match,
+        jnp.where(is_write, True, is_cas & match),
+    )
+    new_state = jnp.where(
+        is_write, v1, jnp.where(is_cas & match, v2, state)
+    )
+    return new_state, ok
+
+
+cas_register = JitModel(
+    name="cas-register",
+    fs=("read", "write", "cas"),
+    init_state=int(NIL32),  # unset
+    step=_cas_register_step,
+)
+
+
+def _register_step(state, f, v1, v2):
+    # f: 0=read 1=write
+    is_write = f == 1
+    ok = jnp.where(is_write, True, (v1 == NIL32) | (state == v1))
+    new_state = jnp.where(is_write, v1, state)
+    return new_state, ok
+
+
+register = JitModel(
+    name="register",
+    fs=("read", "write"),
+    init_state=int(NIL32),
+    step=_register_step,
+)
+
+
+def _mutex_step(state, f, v1, v2):
+    # f: 0=acquire 1=release; state: 0=free 1=held
+    is_acquire = f == 0
+    ok = jnp.where(is_acquire, state == 0, state == 1)
+    new_state = jnp.where(ok, jnp.where(is_acquire, 1, 0), state)
+    return new_state, ok
+
+
+mutex = JitModel(
+    name="mutex",
+    fs=("acquire", "release"),
+    init_state=0,
+    step=_mutex_step,
+)
+
+
+BY_NAME = {m.name: m for m in (cas_register, register, mutex)}
+
+
+def for_model(model) -> JitModel | None:
+    """The JitModel equivalent of a host model instance (fresh state only),
+    or None if the model has no scalar int encoding (queues, sets) — the
+    checker then uses the host search path."""
+    from . import CASRegister, Mutex, Register
+
+    if isinstance(model, CASRegister) and model.value is None:
+        return cas_register
+    if isinstance(model, Register) and model.value is None:
+        return register
+    if isinstance(model, Mutex) and not model.locked:
+        return mutex
+    return None
+
+
+def encode_value(v) -> int:
+    """Encode one payload scalar for the kernel; None -> NIL32."""
+    if v is None:
+        return int(NIL32)
+    v = int(v)
+    if not (-(2**30) < v < 2**30):
+        raise OverflowError(f"value {v} does not fit the int32 kernel encoding")
+    return v

@@ -1,0 +1,7 @@
+"""Analysis kernels.
+
+wgl_host — Wing-Gong-Lowe linearizability search on host (semantics
+          oracle + fallback for models without int32 encodings).
+wgl_tpu  — the same search as a jitted bitmask-DFS over int32 tensors,
+          vmapped over independent keys and sharded over a device mesh.
+"""

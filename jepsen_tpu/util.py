@@ -1,0 +1,273 @@
+"""General-purpose helpers (reference: jepsen/src/jepsen/util.clj).
+
+Thread-parallel maps, retries, timeouts, relative time, interval-set
+strings, and latency extraction over histories.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import contextlib
+import math
+import threading
+import time as _time
+from typing import Any, Callable, Iterable, Sequence
+
+NANOS_PER_SECOND = 1_000_000_000
+NANOS_PER_MS = 1_000_000
+
+
+def majority(n: int) -> int:
+    """Smallest integer m such that m > n/2 (util.clj:59-62)."""
+    return n // 2 + 1
+
+
+def minority(n: int) -> int:
+    """Largest integer m such that m < ceil(n/2) + ... i.e. n - majority(n)."""
+    return n - majority(n)
+
+
+def real_pmap(fn: Callable, coll: Iterable) -> list:
+    """Map fn over coll with one real thread per element, propagating the
+    first exception (util.clj:46-52). Unlike a pooled map, every element
+    gets its own thread immediately — needed when elements block on each
+    other (e.g. barriers across nodes)."""
+    items = list(coll)
+    if not items:
+        return []
+    results: list[Any] = [None] * len(items)
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+
+    def run(i, x):
+        try:
+            results[i] = fn(x)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            with lock:
+                errors.append(e)
+
+    threads = [
+        threading.Thread(target=run, args=(i, x), daemon=True)
+        for i, x in enumerate(items)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+def bounded_pmap(fn: Callable, coll: Iterable, bound: int | None = None) -> list:
+    """Pooled parallel map with at most `bound` workers (util.clj bounded
+    concurrency; default = cpu count + 2)."""
+    items = list(coll)
+    if not items:
+        return []
+    import os
+
+    bound = bound or (os.cpu_count() or 1) + 2
+    with concurrent.futures.ThreadPoolExecutor(max_workers=bound) as ex:
+        return list(ex.map(fn, items))
+
+
+class RetryError(Exception):
+    pass
+
+
+def with_retry(
+    fn: Callable[[], Any],
+    retries: int = 3,
+    backoff: float = 0.0,
+    exceptions: tuple = (Exception,),
+) -> Any:
+    """Call fn, retrying up to `retries` times on exception
+    (util.clj:339-363)."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions:
+            attempt += 1
+            if attempt > retries:
+                raise
+            if backoff:
+                _time.sleep(backoff)
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+def timeout(seconds: float, fn: Callable[[], Any], default: Any = TimeoutError_):
+    """Run fn in a worker thread; on timeout return `default` (or raise if
+    default is the TimeoutError_ sentinel). The worker thread is abandoned,
+    not interrupted — mirror of util.clj:311-322 where the thread IS
+    interrupted; Python offers no safe interrupt, so clients must use their
+    own IO timeouts for cleanup."""
+    result: list = []
+    error: list = []
+
+    def run():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001
+            error.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        if default is TimeoutError_:
+            raise TimeoutError_(f"timed out after {seconds}s")
+        return default
+    if error:
+        raise error[0]
+    return result[0]
+
+
+# ---------------------------------------------------------------------------
+# Relative time (util.clj:271-288)
+
+_relative_origin: int | None = None
+_relative_lock = threading.Lock()
+
+
+def init_relative_time(origin_nanos: int | None = None) -> None:
+    """Set the origin for relative-time-nanos (util.clj:271-280)."""
+    global _relative_origin
+    with _relative_lock:
+        _relative_origin = (
+            origin_nanos if origin_nanos is not None else _time.monotonic_ns()
+        )
+
+
+def relative_time_nanos() -> int:
+    """Nanoseconds since the origin set by init_relative_time
+    (util.clj:282-288). Auto-initialises on first use."""
+    global _relative_origin
+    if _relative_origin is None:
+        init_relative_time()
+    return _time.monotonic_ns() - _relative_origin
+
+
+@contextlib.contextmanager
+def with_relative_time():
+    """Scope with a fresh relative-time origin (util.clj: with-relative-time)."""
+    prev = _relative_origin
+    init_relative_time()
+    try:
+        yield
+    finally:
+        with _relative_lock:
+            globals()["_relative_origin"] = prev
+
+
+def nanos_to_ms(n: float) -> float:
+    return n / NANOS_PER_MS
+
+
+def ms_to_nanos(m: float) -> float:
+    return m * NANOS_PER_MS
+
+
+def nanos_to_secs(n: float) -> float:
+    return n / NANOS_PER_SECOND
+
+
+def secs_to_nanos(s: float) -> float:
+    return s * NANOS_PER_SECOND
+
+
+# ---------------------------------------------------------------------------
+# Pretty things
+
+def integer_interval_set_str(values: Iterable[int]) -> str:
+    """Compact string for a set of integers, collapsing runs:
+    #{1..3 5 7..9} (util.clj:528-553)."""
+    xs = sorted(set(values))
+    if not xs:
+        return "#{}"
+    parts = []
+    lo = prev = xs[0]
+    for x in xs[1:]:
+        if x == prev + 1:
+            prev = x
+            continue
+        parts.append(str(lo) if lo == prev else f"{lo}..{prev}")
+        lo = prev = x
+    parts.append(str(lo) if lo == prev else f"{lo}..{prev}")
+    return "#{" + " ".join(parts) + "}"
+
+
+def longest_common_prefix(seqs: Sequence[Sequence]) -> list:
+    """Longest common prefix of a collection of sequences (util.clj:653-666)."""
+    seqs = list(seqs)
+    if not seqs:
+        return []
+    out = []
+    for i, x in enumerate(seqs[0]):
+        if all(len(s) > i and s[i] == x for s in seqs[1:]):
+            out.append(x)
+        else:
+            break
+    return out
+
+
+def fraction(a: float, b: float) -> float:
+    """a/b, but 1 when b is zero (util.clj)."""
+    return 1.0 if b == 0 else a / b
+
+
+# ---------------------------------------------------------------------------
+# History-derived series (util.clj:598-651)
+
+def history_latencies(history) -> list:
+    """Given a history (sequence of op dicts/Ops), emit the invoke ops with
+    :latency (completion time - invoke time, nanos) attached
+    (util.clj:598-632). Unmatched invokes get latency None."""
+    from .history import op as to_op  # local import to avoid cycle
+
+    out = []
+    open_by_process: dict = {}
+    for op in map(to_op, history):
+        if op.is_invoke:
+            rec = {"op": op, "latency": None, "completion": None}
+            open_by_process[op.process] = rec
+            out.append(rec)
+        else:
+            rec = open_by_process.pop(op.process, None)
+            if rec is not None:
+                rec["latency"] = op.time - rec["op"].time
+                rec["completion"] = op
+    return out
+
+
+def nemesis_intervals(history, start_fs=("start",), stop_fs=("stop",)) -> list:
+    """Pairs of [start-op, stop-op] delimiting nemesis activity windows
+    (util.clj:634-651). Unclosed windows get a None stop."""
+    from .history import op as to_op  # local import to avoid cycle
+
+    out = []
+    current = None
+    for op in map(to_op, history):
+        if op.process != "nemesis" or not op.is_invoke:
+            continue
+        if op.f in start_fs and current is None:
+            current = op
+        elif op.f in stop_fs and current is not None:
+            out.append((current, op))
+            current = None
+    if current is not None:
+        out.append((current, None))
+    return out
+
+
+def rand_exp(mean: float, rng=None) -> float:
+    """Exponentially-distributed random delay with the given mean
+    (util.clj rand-exp; used by generator.stagger)."""
+    import random
+
+    r = rng or random
+    return -mean * math.log(1.0 - r.random())

@@ -1,0 +1,12 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices so all
+mesh/sharding tests run without TPU hardware (the driver separately
+dry-runs the multi-chip path; see __graft_entry__.py)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

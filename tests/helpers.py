@@ -1,0 +1,100 @@
+"""Shared test helpers: a brute-force linearizability oracle and random
+history generators used to cross-check the WGL search."""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu.history import Entries, entries as make_entries
+from jepsen_tpu.models import inconsistent
+
+
+def brute_linearizable(model, history) -> bool:
+    """Exhaustive linearizability check for tiny histories. Enumerates all
+    linearization orders consistent with the real-time partial order
+    (entry a must precede b iff a returned before b was invoked); crashed
+    entries are optional."""
+    es = history if isinstance(history, Entries) else make_entries(history)
+    n = len(es)
+    completed = [not bool(c) for c in es.crashed]
+
+    def rec(remaining: frozenset, state) -> bool:
+        if not any(completed[e] for e in remaining):
+            return True
+        for e in remaining:
+            # e must be minimal: nothing else remaining returned before
+            # e's invocation
+            if any(
+                es.ret_pos[f] < es.call_pos[e] for f in remaining if f != e
+            ):
+                continue
+            s2 = state.step(es.f[e], es.value_out[e])
+            if not inconsistent(s2) and rec(remaining - {e}, s2):
+                return True
+        return False
+
+    return rec(frozenset(range(n)), model)
+
+
+def random_register_history(
+    n_process=3,
+    n_ops=12,
+    n_values=3,
+    cas=True,
+    corrupt=0.0,
+    seed=0,
+):
+    """A random concurrent register history produced by simulating a real
+    (atomic) register — linearizable by construction unless `corrupt` > 0,
+    in which case some read results are randomized (then the oracle
+    decides). Returns a list of Ops."""
+    from jepsen_tpu.history import Op
+
+    rng = random.Random(seed)
+    history = []
+    t = 0
+    reg = [None]
+    pending = {}  # process -> (f, value, result)
+    procs = list(range(n_process))
+    ops_started = 0
+    while ops_started < n_ops or pending:
+        p = rng.choice(procs)
+        if p in pending:
+            f, value, result = pending.pop(p)
+            kind = rng.random()
+            if kind < 0.08:
+                history.append(Op(p, "info", f, value, time=t))
+            else:
+                history.append(Op(p, "ok", f, result, time=t))
+        elif ops_started < n_ops:
+            ops_started += 1
+            roll = rng.random()
+            if roll < 0.4:
+                f, value = "read", None
+                result = reg[0]
+                if corrupt and rng.random() < corrupt:
+                    result = rng.randrange(n_values)
+            elif roll < 0.75 or not cas:
+                f = "write"
+                value = rng.randrange(n_values)
+                reg[0] = value
+                result = value
+            else:
+                f = "cas"
+                value = (rng.randrange(n_values), rng.randrange(n_values))
+                if reg[0] == value[0]:
+                    reg[0] = value[1]
+                    result = value
+                else:
+                    # a real register would fail this CAS; record :fail
+                    history.append(Op(p, "invoke", f, value, time=t))
+                    t += 1
+                    history.append(Op(p, "fail", f, value, time=t))
+                    t += 1
+                    continue
+            history.append(Op(p, "invoke", f, value, time=t))
+            pending[p] = (f, value, result)
+        t += 1
+    for i, o in enumerate(history):
+        o.index = i
+    return history

@@ -1,0 +1,289 @@
+"""Checker suite tests — literal histories with exact expected result
+maps, in the style of the reference's checker_test.clj."""
+
+from jepsen_tpu import checker
+from jepsen_tpu.checker import (
+    check_safe,
+    compose,
+    counter,
+    linearizable,
+    merge_valid,
+    queue,
+    set_checker,
+    set_full,
+    total_queue,
+    unbridled_optimism,
+    unique_ids,
+)
+from jepsen_tpu.history import (
+    index,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+)
+from jepsen_tpu.models import CASRegister, UnorderedQueue
+
+
+def h(*ops):
+    return index(list(ops))
+
+
+class TestMergeValid:
+    def test_dominance(self):
+        assert merge_valid([]) is True
+        assert merge_valid([True, True]) is True
+        assert merge_valid([True, "unknown"]) == "unknown"
+        assert merge_valid([False, "unknown", True]) is False
+
+
+class TestCompose:
+    def test_compose(self):
+        c = compose(
+            {"opt": unbridled_optimism(), "set": set_checker()}
+        )
+        r = c.check({}, h(invoke_op(0, "add", 1), ok_op(0, "add", 1)), {})
+        assert r["opt"]["valid"] is True
+        assert r["set"]["valid"] == "unknown"  # never read
+        assert r["valid"] == "unknown"
+
+    def test_check_safe_wraps_errors(self):
+        class Boom(checker.Checker):
+            def check(self, test, history, opts=None):
+                raise RuntimeError("boom")
+
+        r = check_safe(Boom(), {}, [], {})
+        assert r["valid"] == "unknown"
+        assert "boom" in r["error"]
+
+
+class TestSetChecker:
+    def test_ok(self):
+        hist = h(
+            invoke_op(0, "add", 1), ok_op(0, "add", 1),
+            invoke_op(0, "add", 2), ok_op(0, "add", 2),
+            invoke_op(1, "read"), ok_op(1, "read", [1, 2]),
+        )
+        r = set_checker().check({}, hist, {})
+        assert r["valid"] is True
+        assert r["ok_count"] == 2 and r["lost_count"] == 0
+
+    def test_lost_and_unexpected(self):
+        hist = h(
+            invoke_op(0, "add", 1), ok_op(0, "add", 1),
+            invoke_op(0, "add", 2), ok_op(0, "add", 2),
+            invoke_op(1, "read"), ok_op(1, "read", [2, 99]),
+        )
+        r = set_checker().check({}, hist, {})
+        assert r["valid"] is False
+        assert r["lost"] == "#{1}"
+        assert r["unexpected"] == "#{99}"
+
+    def test_recovered(self):
+        hist = h(
+            invoke_op(0, "add", 1), info_op(0, "add", 1),
+            invoke_op(1, "read"), ok_op(1, "read", [1]),
+        )
+        r = set_checker().check({}, hist, {})
+        assert r["valid"] is True
+        assert r["recovered_count"] == 1
+
+
+class TestSetFull:
+    def test_stable(self):
+        hist = h(
+            invoke_op(0, "add", 1, time=0), ok_op(0, "add", 1, time=1),
+            invoke_op(1, "read", time=2), ok_op(1, "read", {1}, time=3),
+        )
+        r = set_full().check({}, hist, {})
+        assert r["valid"] is True
+        assert r["stable_count"] == 1
+
+    def test_lost(self):
+        hist = h(
+            invoke_op(0, "add", 1, time=0), ok_op(0, "add", 1, time=1),
+            invoke_op(1, "read", time=2), ok_op(1, "read", {1}, time=3),
+            invoke_op(1, "read", time=4), ok_op(1, "read", set(), time=5),
+        )
+        r = set_full().check({}, hist, {})
+        assert r["valid"] is False
+        assert r["lost"] == [1]
+
+    def test_stale_read_allowed_unless_linearizable(self):
+        # add completes at t=1; read starting at t=2 misses it; later read
+        # at t=4 sees it -> stable but stale
+        hist = h(
+            invoke_op(0, "add", 1, time=0), ok_op(0, "add", 1, time=1_000_000),
+            invoke_op(1, "read", time=2_000_000),
+            ok_op(1, "read", set(), time=3_000_000),
+            invoke_op(1, "read", time=4_000_000),
+            ok_op(1, "read", {1}, time=5_000_000),
+        )
+        r = set_full().check({}, hist, {})
+        assert r["valid"] is True
+        assert r["stale_count"] == 1
+        r2 = set_full(linearizable=True).check({}, hist, {})
+        assert r2["valid"] is False
+
+    def test_no_stable_elements_unknown(self):
+        hist = h(invoke_op(0, "add", 1), info_op(0, "add", 1))
+        r = set_full().check({}, hist, {})
+        assert r["valid"] == "unknown"
+
+    def test_never_read_when_absent_read_concurrent_with_add(self):
+        # read concurrent with the add misses it; no later reads ->
+        # never-read, not lost (checker.clj:291-300 asymmetry)
+        hist = h(
+            invoke_op(1, "read", time=0),
+            invoke_op(0, "add", 1, time=1),
+            ok_op(1, "read", set(), time=2),
+            ok_op(0, "add", 1, time=3),
+        )
+        r = set_full().check({}, hist, {})
+        assert r["never_read"] == [1]
+
+
+class TestQueueCheckers:
+    def test_queue_model_fold(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        )
+        assert queue(UnorderedQueue()).check({}, hist, {})["valid"] is True
+        bad = h(invoke_op(1, "dequeue"), ok_op(1, "dequeue", 3))
+        assert queue(UnorderedQueue()).check({}, bad, {})["valid"] is False
+
+    def test_total_queue_lost(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        )
+        r = total_queue().check({}, hist, {})
+        assert r["valid"] is False
+        assert r["lost"] == {2: 1}
+
+    def test_total_queue_drain_and_recovered(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1), info_op(0, "enqueue", 1),
+            invoke_op(1, "drain"), ok_op(1, "drain", [1]),
+        )
+        r = total_queue().check({}, hist, {})
+        assert r["valid"] is True
+        assert r["recovered_count"] == 1
+
+    def test_total_queue_unexpected(self):
+        hist = h(invoke_op(1, "dequeue"), ok_op(1, "dequeue", 42))
+        r = total_queue().check({}, hist, {})
+        assert r["valid"] is False
+        assert r["unexpected"] == {42: 1}
+
+
+class TestUniqueIds:
+    def test_unique(self):
+        hist = h(
+            invoke_op(0, "generate"), ok_op(0, "generate", 1),
+            invoke_op(0, "generate"), ok_op(0, "generate", 2),
+        )
+        r = unique_ids().check({}, hist, {})
+        assert r["valid"] is True and r["range"] == [1, 2]
+
+    def test_duplicates(self):
+        hist = h(
+            invoke_op(0, "generate"), ok_op(0, "generate", 1),
+            invoke_op(0, "generate"), ok_op(0, "generate", 1),
+        )
+        r = unique_ids().check({}, hist, {})
+        assert r["valid"] is False
+        assert r["duplicated"] == {1: 2}
+
+
+class TestCounter:
+    def test_within_bounds(self):
+        hist = h(
+            invoke_op(0, "add", 1), ok_op(0, "add", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+            invoke_op(0, "add", 2),  # pending add widens upper bound
+            invoke_op(1, "read"), ok_op(1, "read", 3),
+        )
+        r = counter().check({}, hist, {})
+        assert r["valid"] is True
+
+    def test_out_of_bounds(self):
+        hist = h(
+            invoke_op(0, "add", 1), ok_op(0, "add", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 5),
+        )
+        r = counter().check({}, hist, {})
+        assert r["valid"] is False
+        assert r["errors"] == [(1, 5, 1)]
+
+    def test_read_sees_acknowledged_lower_bound(self):
+        # read invoked before an add is acknowledged may miss it
+        hist = h(
+            invoke_op(1, "read"),
+            invoke_op(0, "add", 1), ok_op(0, "add", 1),
+            ok_op(1, "read", 0),
+        )
+        assert counter().check({}, hist, {})["valid"] is True
+
+
+class TestLinearizableChecker:
+    def test_host_backend(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        c = linearizable(CASRegister(), algorithm="host")
+        assert c.check({}, hist, {})["valid"] is True
+
+    def test_invalid_reports_op(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 2),
+        )
+        c = linearizable(CASRegister(), algorithm="host")
+        r = c.check({}, hist, {})
+        assert r["valid"] is False
+        assert "op" in r
+
+    def test_model_from_test_map(self):
+        hist = h(invoke_op(0, "write", 1), ok_op(0, "write", 1))
+        c = linearizable(algorithm="host")
+        assert c.check({"model": CASRegister()}, hist, {})["valid"] is True
+
+    def test_competition(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        c = linearizable(CASRegister(), algorithm="competition")
+        assert c.check({}, hist, {})["valid"] is True
+
+
+class TestReviewRegressions:
+    def test_auto_backend_works_out_of_the_box(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        # default algorithm="auto" must never crash, with or without the
+        # tpu kernel module present
+        assert linearizable(CASRegister()).check({}, hist, {})["valid"] is True
+
+    def test_competition_unknown_does_not_hang(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        )
+        c = linearizable(UnorderedQueue(), algorithm="competition")
+        c.time_limit = None
+        # tpu-ineligible model + forced-unknown host verdict: must return
+        import jepsen_tpu.ops.wgl_host as wh
+        orig = wh.analysis
+        try:
+            wh.analysis = lambda *a, **k: wh.WGLResult(valid="unknown")
+            r = c.check({}, hist, {})
+            assert r["valid"] == "unknown"
+        finally:
+            wh.analysis = orig

@@ -1,0 +1,165 @@
+"""History core tests (modelled on the reference's checker/history fixtures,
+jepsen/test/jepsen/checker_test.clj style: literal hand-built histories)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import (
+    FAIL,
+    INFO,
+    INVOKE,
+    NIL,
+    OK,
+    Op,
+    REGISTER_SCHEMA,
+    TensorHistory,
+    complete,
+    entries,
+    index,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+    pairs,
+)
+
+
+def h(*ops):
+    return index(list(ops))
+
+
+class TestPairs:
+    def test_simple_pairing(self):
+        hist = h(
+            invoke_op(0, "write", 1),
+            ok_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 1),
+        )
+        ps = pairs(hist)
+        assert len(ps) == 2
+        assert ps[0].ok and ps[0].value == 1
+        assert ps[1].ok and ps[1].value == 1  # read value from completion
+
+    def test_fail_pair(self):
+        hist = h(invoke_op(0, "write", 1), fail_op(0, "write", 1))
+        ps = pairs(hist)
+        assert ps[0].failed and not ps[0].ok and not ps[0].crashed
+
+    def test_crashed_pair(self):
+        hist = h(invoke_op(0, "write", 1), info_op(0, "write", 1))
+        assert pairs(hist)[0].crashed
+
+    def test_pending_pair_is_crashed(self):
+        hist = h(invoke_op(0, "write", 1))
+        assert pairs(hist)[0].crashed
+
+    def test_interleaved(self):
+        hist = h(
+            invoke_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", None),
+            ok_op(0, "write", 1),
+        )
+        ps = pairs(hist)
+        assert [p.invoke.process for p in ps] == [0, 1]
+        assert ps[0].completion.index == 3
+
+    def test_double_invoke_raises(self):
+        with pytest.raises(ValueError):
+            pairs(h(invoke_op(0, "read"), invoke_op(0, "read")))
+
+
+class TestComplete:
+    def test_fills_read_value(self):
+        hist = h(invoke_op(0, "read"), ok_op(0, "read", 42))
+        assert complete(hist)[0].value == 42
+
+    def test_leaves_writes(self):
+        hist = h(invoke_op(0, "write", 7), ok_op(0, "write", 7))
+        assert complete(hist)[0].value == 7
+
+
+class TestTensorHistory:
+    def test_round_trip(self):
+        hist = h(
+            invoke_op(0, "cas", (1, 2)),
+            Op("nemesis", "invoke", None, None, time=5),
+            ok_op(0, "cas", (1, 2)),
+            invoke_op(1, "read"),
+            info_op(1, "read", None),
+        )
+        t = TensorHistory.encode(hist, REGISTER_SCHEMA)
+        assert len(t) == 5
+        assert t.type.tolist() == [INVOKE, INVOKE, OK, INVOKE, INFO]
+        assert t.value[0].tolist() == [1, 2]
+        assert t.value[3].tolist() == [NIL, NIL]
+        back = t.decode()
+        assert back[0].value == (1, 2)
+        assert back[1].process == "nemesis"
+        assert back[3].value is None
+        assert [o.index for o in back] == [0, 1, 2, 3, 4]
+
+    def test_save_load(self, tmp_path):
+        hist = h(invoke_op(0, "write", 3), ok_op(0, "write", 3))
+        t = TensorHistory.encode(hist)
+        p = tmp_path / "hist.npz"
+        t.save(p)
+        t2 = TensorHistory.load(p)
+        assert np.array_equal(t2.value, t.value)
+        assert t2.decode()[0].f == "write"
+
+
+class TestEntries:
+    def test_excludes_failed(self):
+        hist = h(
+            invoke_op(0, "write", 1),
+            fail_op(0, "write", 1),
+            invoke_op(1, "write", 2),
+            ok_op(1, "write", 2),
+        )
+        es = entries(hist)
+        assert len(es) == 1
+        assert es.value_in[0] == 2
+
+    def test_crashed_returns_after_everything(self):
+        hist = h(
+            invoke_op(0, "write", 1),
+            info_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 1),
+        )
+        es = entries(hist)
+        assert es.crashed[0] and not es.crashed[1]
+        # entry 0's return is after entry 1's return
+        assert es.ret_pos[0] > es.ret_pos[1]
+        assert es.n_completed == 1
+
+    def test_event_positions_are_dense(self):
+        hist = h(
+            invoke_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(0, "write", 1),
+            ok_op(1, "read", 1),
+        )
+        es = entries(hist)
+        all_pos = sorted(list(es.call_pos) + list(es.ret_pos))
+        assert all_pos == list(range(4))
+        assert es.call_pos[0] < es.call_pos[1] < es.ret_pos[0] < es.ret_pos[1]
+
+    def test_nemesis_ops_excluded(self):
+        hist = h(
+            Op("nemesis", "invoke", "start", None),
+            invoke_op(0, "read"),
+            ok_op(0, "read", None),
+            Op("nemesis", "ok", "start", None),
+        )
+        assert len(entries(hist)) == 1
+
+
+def test_encode_rejects_sentinel_collision():
+    from jepsen_tpu.history import FSchema
+
+    s = FSchema(["write"], width=1)
+    with pytest.raises(OverflowError):
+        s._encode("write", 2**62)

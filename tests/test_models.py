@@ -1,0 +1,150 @@
+"""Model semantics (knossos.model parity) + host<->jit equivalence."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models
+from jepsen_tpu.models import (
+    CASRegister,
+    FIFOQueue,
+    GrowOnlySet,
+    Mutex,
+    NoOp,
+    Register,
+    UnorderedQueue,
+    inconsistent,
+)
+from jepsen_tpu.models import jit as mjit
+
+
+class TestCASRegister:
+    def test_write_read(self):
+        m = CASRegister()
+        m = m.step("write", 3)
+        assert m == CASRegister(3)
+        assert m.step("read", 3) == m
+        assert inconsistent(m.step("read", 4))
+
+    def test_cas(self):
+        m = CASRegister(1)
+        assert m.step("cas", (1, 2)) == CASRegister(2)
+        assert inconsistent(m.step("cas", (3, 4)))
+
+    def test_unknown_read_ok(self):
+        assert CASRegister(5).step("read", None) == CASRegister(5)
+
+    def test_hashable(self):
+        assert len({CASRegister(1), CASRegister(1), CASRegister(2)}) == 2
+
+
+class TestMutex:
+    def test_acquire_release(self):
+        m = Mutex()
+        m2 = m.step("acquire", None)
+        assert m2 == Mutex(True)
+        assert inconsistent(m2.step("acquire", None))
+        assert m2.step("release", None) == Mutex(False)
+        assert inconsistent(m.step("release", None))
+
+
+class TestQueues:
+    def test_unordered(self):
+        q = UnorderedQueue()
+        q = q.step("enqueue", 1).step("enqueue", 2)
+        q2 = q.step("dequeue", 2)  # out of order OK
+        assert not inconsistent(q2)
+        assert inconsistent(q2.step("dequeue", 2))
+
+    def test_fifo(self):
+        q = FIFOQueue()
+        q = q.step("enqueue", 1).step("enqueue", 2)
+        assert inconsistent(q.step("dequeue", 2))
+        assert not inconsistent(q.step("dequeue", 1))
+
+
+class TestSet:
+    def test_add_read(self):
+        s = GrowOnlySet()
+        s = s.step("add", 1).step("add", 2)
+        assert not inconsistent(s.step("read", [1, 2]))
+        assert inconsistent(s.step("read", [1]))
+
+
+class TestNoOp:
+    def test_everything_ok(self):
+        assert NoOp().step("anything", 42) == NoOp()
+
+
+# ---------------------------------------------------------------------------
+# jit equivalence: random op sequences must transition identically
+
+def _host_state_to_int(m):
+    if isinstance(m, (CASRegister, Register)):
+        return int(mjit.NIL32) if m.value is None else m.value
+    if isinstance(m, Mutex):
+        return 1 if m.locked else 0
+    raise TypeError(m)
+
+
+def _int_to_host_state(name, s):
+    s = int(s)
+    if name == "cas-register":
+        return CASRegister(None if s == int(mjit.NIL32) else s)
+    if name == "register":
+        return Register(None if s == int(mjit.NIL32) else s)
+    return Mutex(bool(s))
+
+
+def _decode_value(name, f, v1, v2):
+    nil = int(mjit.NIL32)
+    if f == "cas":
+        return (v1, v2)
+    if f in ("read", "write"):
+        return None if v1 == nil else v1
+    return None
+
+
+@pytest.mark.parametrize("name", ["cas-register", "register", "mutex"])
+def test_jit_step_matches_host(name):
+    """Exhaustive equivalence over the full small domain of (state, f, v1,
+    v2), verified in a single vmapped call (per-dispatch overhead on this
+    host is large; the kernel design batches for the same reason)."""
+    import itertools
+
+    import jax
+
+    jm = mjit.BY_NAME[name]
+    nil = int(mjit.NIL32)
+    if name == "mutex":
+        states, vs = [0, 1], [nil]
+    else:
+        states, vs = [nil, 0, 1, 2], [nil, 0, 1, 2]
+    combos = list(
+        itertools.product(states, range(len(jm.fs)), vs, [v for v in vs if v != nil] + [nil])
+    )
+    arr = np.array(combos, np.int32)
+    new_states, oks = jax.jit(jax.vmap(jm.step))(
+        arr[:, 0], arr[:, 1], arr[:, 2], arr[:, 3]
+    )
+    new_states, oks = np.asarray(new_states), np.asarray(oks)
+    for (s, fc, v1, v2), ns, ok in zip(combos, new_states, oks):
+        f = jm.fs[fc]
+        host = _int_to_host_state(name, s)
+        value = _decode_value(name, f, v1, v2)
+        if f == "cas" and nil in value:
+            continue  # encoder never emits a cas with nil args
+        host_next = host.step(f, value)
+        if inconsistent(host_next):
+            assert not bool(ok), (f, value, host, s)
+        else:
+            assert bool(ok), (f, value, host, s)
+            assert int(ns) == _host_state_to_int(host_next), (f, value, host, s)
+
+
+def test_for_model_mapping():
+    assert mjit.for_model(CASRegister()) is mjit.cas_register
+    assert mjit.for_model(CASRegister(3)) is None  # non-fresh state
+    assert mjit.for_model(Mutex()) is mjit.mutex
+    assert mjit.for_model(UnorderedQueue()) is None

@@ -1,0 +1,81 @@
+"""util tests (reference: jepsen/test/jepsen/util_test.clj)."""
+
+import pytest
+
+from jepsen_tpu.util import (
+    history_latencies,
+    integer_interval_set_str,
+    longest_common_prefix,
+    majority,
+    minority,
+    nemesis_intervals,
+    real_pmap,
+    timeout,
+    TimeoutError_,
+    with_retry,
+)
+
+
+def test_majority():
+    assert [majority(n) for n in range(1, 6)] == [1, 2, 2, 3, 3]
+    assert minority(5) == 2
+
+
+def test_interval_set_str():
+    assert integer_interval_set_str([]) == "#{}"
+    assert integer_interval_set_str([1]) == "#{1}"
+    assert integer_interval_set_str([1, 2, 3, 5, 7, 8, 9]) == "#{1..3 5 7..9}"
+
+
+def test_longest_common_prefix():
+    assert longest_common_prefix([[1, 2, 3], [1, 2, 4]]) == [1, 2]
+    assert longest_common_prefix([]) == []
+
+
+def test_real_pmap_propagates_errors():
+    with pytest.raises(ZeroDivisionError):
+        real_pmap(lambda x: 1 // x, [1, 0, 2])
+    assert real_pmap(lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+
+
+def test_with_retry():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("nope")
+        return "ok"
+
+    assert with_retry(flaky, retries=3) == "ok"
+
+
+def test_timeout():
+    assert timeout(1.0, lambda: 42) == 42
+    import time
+
+    assert timeout(0.05, lambda: time.sleep(5), default="late") == "late"
+    with pytest.raises(TimeoutError_):
+        timeout(0.05, lambda: time.sleep(5))
+
+
+def test_history_latencies_accepts_dicts():
+    hist = [
+        {"process": 0, "type": "invoke", "f": "read", "time": 10},
+        {"process": 0, "type": "ok", "f": "read", "time": 35},
+        {"process": 1, "type": "invoke", "f": "read", "time": 20},
+    ]
+    ls = history_latencies(hist)
+    assert ls[0]["latency"] == 25
+    assert ls[1]["latency"] is None
+
+
+def test_nemesis_intervals():
+    hist = [
+        {"process": "nemesis", "type": "invoke", "f": "start", "time": 1},
+        {"process": "nemesis", "type": "ok", "f": "start", "time": 2},
+        {"process": "nemesis", "type": "invoke", "f": "stop", "time": 9},
+    ]
+    iv = nemesis_intervals(hist)
+    assert len(iv) == 1
+    assert iv[0][0].time == 1 and iv[0][1].time == 9
