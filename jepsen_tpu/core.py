@@ -1,0 +1,436 @@
+"""Test orchestration: the execution core (reference: jepsen.core,
+core.clj).
+
+`run(test)` threads a single immutable-ish test dict through every layer
+(core.clj:540-560): connect the control plane, provision the OS, cycle
+the DB, spawn one client worker per process plus a nemesis worker, pull
+ops from the generator until exhaustion, record the history, then analyze
+it with the checker and persist results.
+
+Worker semantics preserved from the reference:
+- processes stripe over nodes round-robin (core.clj:485-496)
+- a client exception makes the completion :info — the outcome is unknown
+  (core.clj:271-304); the process is then reincarnated as process+n so
+  every logical process stays single-threaded forever (core.clj:410-427)
+- nemesis ops are journaled to every active history (core.clj:338-350)
+- workers synchronize setup/run/teardown through latches so no client
+  starts before all are ready (core.clj:171-268)
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+from typing import Any
+
+from . import checker as checker_mod
+from . import control, db as db_mod, generator
+from .history import Op, index
+from .util import (
+    CountDownLatch,
+    log_op_logger,
+    real_pmap,
+    relative_time_nanos,
+    with_relative_time,
+)
+
+log = logging.getLogger("jepsen_tpu.core")
+
+
+def conj_op(test, op: Op) -> Op:
+    """Append an op to the test's history (core.clj:30-38)."""
+    with test["_history_lock"]:
+        test["_history"].append(op)
+    return op
+
+
+class WorkerAbort(Exception):
+    pass
+
+
+class Worker:
+    """Synchronized setup/run/teardown lifecycle (core.clj:161-169)."""
+
+    name = "worker"
+
+    def __init__(self):
+        self.abort = threading.Event()
+
+    def setup(self):
+        pass
+
+    def run(self):
+        pass
+
+    def teardown(self):
+        pass
+
+
+def do_worker(worker: Worker, abort_all, run_latch, teardown_latch):
+    """Run one worker through its phases with error recovery; returns the
+    first error, or None (core.clj:171-225)."""
+    error = None
+    try:
+        log.debug("Starting %s", worker.name)
+        worker.setup()
+    except BaseException as e:  # noqa: BLE001
+        log.warning("Error setting up %s", worker.name, exc_info=True)
+        error = e
+        abort_all(worker)
+    if error is None:
+        run_latch.count_down()
+        run_latch.await_()
+        try:
+            worker.run()
+        except BaseException as e:  # noqa: BLE001
+            if not isinstance(e, WorkerAbort):
+                log.warning("Error running %s", worker.name, exc_info=True)
+                error = e
+            abort_all(worker)
+    else:
+        run_latch.count_down()
+    teardown_latch.count_down()
+    teardown_latch.await_()
+    try:
+        log.debug("Stopping %s", worker.name)
+        worker.teardown()
+    except BaseException as e:  # noqa: BLE001
+        log.warning("Error tearing down %s", worker.name, exc_info=True)
+        error = error or e
+    return error
+
+
+def run_workers(test, workers) -> None:
+    """Run all workers to completion; re-raise the error of the worker
+    that aborted the run, if any (core.clj:227-268)."""
+    n = len(workers)
+    run_latch = CountDownLatch(n)
+    teardown_latch = CountDownLatch(n)
+    aborting: list = []
+    abort_lock = threading.Lock()
+
+    def abort_all(worker):
+        with abort_lock:
+            if not aborting:
+                aborting.append(worker)
+        for w in workers:
+            w.abort.set()
+        # Wake anyone blocked at a generator barrier; without this a
+        # crashed worker leaves phases()/synchronize() waiters deadlocked
+        generator.break_barriers()
+
+    results: list = [None] * n
+    threads_binding = [generator.NEMESIS] + list(range(test["concurrency"]))
+
+    def runner(i, w):
+        with generator.with_threads(threads_binding):
+            results[i] = do_worker(w, abort_all, run_latch, teardown_latch)
+
+    threads = [
+        threading.Thread(
+            target=runner, args=(i, w), name=f"jepsen {w.name}", daemon=True
+        )
+        for i, w in enumerate(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with abort_lock:
+        if aborting:
+            for w, r in zip(workers, results):
+                if w is aborting[0] and r is not None:
+                    raise r
+
+
+def invoke_op(op: Op, test, client, abort: threading.Event) -> Op:
+    """Apply op to a client; exceptions become :info completions — the
+    outcome is unknown (core.clj:271-304)."""
+    try:
+        completion = client.invoke(test, op)
+        completion = completion.with_(time=relative_time_nanos())
+    except BaseException as e:  # noqa: BLE001
+        if abort.is_set():
+            raise
+        log.warning("Process %s crashed", op.process, exc_info=True)
+        return op.with_(
+            type="info",
+            time=relative_time_nanos(),
+            error=f"indeterminate: {e}",
+        )
+    t = completion.type
+    assert t in ("ok", "fail", "info"), (
+        f"client invoke must complete with ok/fail/info, got {completion!r}"
+    )
+    assert completion.process == op.process
+    assert completion.f == op.f
+    return completion
+
+
+class ClientWorker(Worker):
+    """One worker per initial process id, bound to a node
+    (core.clj:352-440)."""
+
+    def __init__(self, test, process: int, node):
+        super().__init__()
+        self.test = test
+        self.node = node
+        self.process = process
+        self.client = None
+        self.name = f"worker {process}"
+
+    def setup(self):
+        self.client = self.test["client"].open(self.test, self.node)
+
+    def run(self):
+        test = self.test
+        gen = test["generator"]
+        while True:
+            if self.abort.is_set():
+                raise WorkerAbort()
+            o = generator.op_and_validate(gen, test, self.process)
+            if o is None:
+                return
+            op = Op.from_dict(o).with_(
+                process=self.process, time=relative_time_nanos()
+            )
+            if op.type is None:
+                op = op.with_(type="invoke")
+            log_op_logger(op)
+            if self.client is None:
+                try:
+                    self.client = test["client"].open(test, self.node)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("Error opening client", exc_info=True)
+                    fail = op.with_(
+                        type="fail",
+                        error=("no-client", str(e)),
+                        time=relative_time_nanos(),
+                    )
+                    conj_op(test, op)
+                    conj_op(test, fail)
+                    self.client = None
+                    continue
+            conj_op(test, op)
+            completion = invoke_op(op, test, self.client, self.abort)
+            conj_op(test, completion)
+            log_op_logger(completion)
+            if completion.is_info:
+                # All bets are off: the op may or may not have taken
+                # effect. The process is hung; reincarnate it so each
+                # logical process stays single-threaded (core.clj:410-427).
+                self.process += test["concurrency"]
+                try:
+                    self.client.close(test)
+                except Exception:  # noqa: BLE001
+                    log.warning("Error closing client", exc_info=True)
+                self.client = None
+
+    def teardown(self):
+        if self.client is not None:
+            self.client.close(self.test)
+            self.client = None
+
+
+class NemesisWorker(Worker):
+    """Drives the nemesis from the same generator (core.clj:442-473)."""
+
+    name = "nemesis"
+
+    def __init__(self, test):
+        super().__init__()
+        self.test = test
+        self.nemesis = None
+
+    def setup(self):
+        self.nemesis = self.test["nemesis"].setup(self.test)
+
+    def run(self):
+        test = self.test
+        gen = test["generator"]
+        while True:
+            if self.abort.is_set():
+                raise WorkerAbort()
+            o = generator.op_and_validate(gen, test, generator.NEMESIS)
+            if o is None:
+                return
+            op = Op.from_dict(o).with_(
+                process=generator.NEMESIS, time=relative_time_nanos()
+            )
+            if op.type is None:
+                op = op.with_(type="info")
+            self._apply(op)
+
+    def _apply(self, op: Op) -> Op:
+        """Journal to ALL active histories, invoke, journal completion
+        (core.clj:338-350); exceptions -> :info (core.clj:308-336)."""
+        test = self.test
+        log_op_logger(op)
+        for hist, lock in list(test["active_histories"]):
+            with lock:
+                hist.append(op)
+        try:
+            completion = self.nemesis.invoke(test, op).with_(
+                time=relative_time_nanos()
+            )
+            assert completion.type == "info", completion
+            assert completion.f == op.f, completion
+        except BaseException as e:  # noqa: BLE001
+            if self.abort.is_set():
+                raise
+            log.warning("Nemesis crashed", exc_info=True)
+            completion = op.with_(
+                type="info",
+                time=relative_time_nanos(),
+                error=f"indeterminate: {e}",
+            )
+        for hist, lock in list(test["active_histories"]):
+            with lock:
+                hist.append(completion)
+        log_op_logger(completion)
+        return completion
+
+    def teardown(self):
+        if self.nemesis is not None:
+            self.nemesis.teardown(self.test)
+
+
+def run_case(test) -> list:
+    """Spawn nemesis + client workers, run one case, return its history
+    (core.clj:475-504)."""
+    history: list = []
+    lock = threading.Lock()
+    test["_history"] = history
+    test["_history_lock"] = lock
+    test["active_histories"].append((history, lock))
+    try:
+        nodes = test["nodes"] or [None]
+        client_nodes = [
+            nodes[i % len(nodes)] for i in range(test["concurrency"])
+        ]
+        workers = [NemesisWorker(test)] + [
+            ClientWorker(test, p, node)
+            for p, node in enumerate(client_nodes)
+        ]
+        run_workers(test, workers)
+    finally:
+        test["active_histories"].remove((history, lock))
+    return history
+
+
+def snarf_logs(test) -> None:
+    """Download DB log files from every node into the store directory
+    (core.clj:98-130)."""
+    dbo = test.get("db")
+    if not isinstance(dbo, db_mod.LogFiles) or not test.get("start_time"):
+        return
+    try:
+        from . import store
+    except ImportError:
+        return
+
+    def snarf(node):
+        for path in dbo.log_files(test, node):
+            dest = store.path(test, [str(node), path.lstrip("/").replace("/", "_")])
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            try:
+                test["remote"].download(node, path, dest)
+            except Exception:  # noqa: BLE001
+                log.warning("couldn't download %s from %s", path, node)
+
+    real_pmap(snarf, test["nodes"])
+
+
+def analyze(test) -> dict:
+    """Index the history, run the checker, persist results
+    (core.clj:506-523)."""
+    log.info("Analyzing...")
+    test["history"] = index(test["history"])
+    test["results"] = checker_mod.check_safe(
+        test["checker"], test, test["history"], {}
+    )
+    log.info("Analysis complete")
+    if test.get("name") and test.get("start_time"):
+        try:
+            from . import store
+
+            store.save_2(test)
+        except ImportError:
+            pass
+    return test
+
+
+def prepare(test: dict) -> dict:
+    """Fill in derived test-map fields (core.clj:593-608)."""
+    test = dict(test)
+    test.setdefault("nodes", [])
+    test.setdefault("concurrency", max(1, len(test["nodes"])))
+    test.setdefault("start_time", datetime.datetime.now())
+    test["active_histories"] = []
+    test["remote"] = control.remote_for_test(test)
+    return test
+
+
+def run(test: dict) -> dict:
+    """Run a complete test: provision, execute, analyze
+    (core.clj:539-640). Returns the test dict with :history and :results."""
+    test = prepare(test)
+    try:
+        from . import store
+
+        store.start_logging(test)
+    except ImportError:
+        store = None  # type: ignore[assignment]
+
+    try:
+        for node in test["nodes"]:
+            test["remote"].connect(node)
+        try:
+            # OS setup
+            osys = test.get("os")
+            if osys is not None:
+                control.on_nodes(test, osys.setup)
+            try:
+                # DB cycle (teardown -> setup, with retries)
+                if test.get("db") is not None:
+                    db_mod.cycle(test)
+                try:
+                    with with_relative_time():
+                        test["history"] = run_case(test)
+                    log.info("Run complete, writing")
+                    if store is not None and test.get("name"):
+                        store.save_1(test)
+                    analyze(test)
+                finally:
+                    try:
+                        snarf_logs(test)
+                    except Exception:  # noqa: BLE001
+                        log.warning("log snarfing failed", exc_info=True)
+                    if test.get("db") is not None:
+                        control.on_nodes(
+                            test,
+                            lambda t, n: test["db"].teardown(t, n),
+                        )
+            finally:
+                if osys is not None:
+                    control.on_nodes(test, osys.teardown)
+        finally:
+            for node in test["nodes"]:
+                test["remote"].disconnect(node)
+        log_results(test)
+        return test
+    finally:
+        if store is not None:
+            store.stop_logging(test)
+
+
+def log_results(test) -> dict:
+    r = test.get("results", {})
+    if r.get("valid") is True:
+        log.info("Everything looks good! (valid)")
+    elif r.get("valid") == "unknown":
+        log.warning("Analysis returned :unknown")
+    else:
+        log.warning("Analysis invalid!")
+    return test

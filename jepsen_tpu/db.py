@@ -1,0 +1,74 @@
+"""Database lifecycle protocols (reference: jepsen.db, db.clj:8-67)."""
+
+from __future__ import annotations
+
+import logging
+
+from .util import real_pmap
+
+log = logging.getLogger("jepsen_tpu.db")
+
+CYCLE_TRIES = 3
+
+
+class SetupFailed(Exception):
+    """Raise from DB.setup to request a teardown+setup retry
+    (db.clj ::setup-failed)."""
+
+
+class DB:
+    def setup(self, test, node) -> None:
+        """Set up the database on this node."""
+
+    def teardown(self, test, node) -> None:
+        """Tear down the database on this node."""
+
+
+class Primary:
+    """Mixin: one-time setup on a single (first) node (db.clj:12-13)."""
+
+    def setup_primary(self, test, node) -> None:
+        raise NotImplementedError
+
+
+class LogFiles:
+    """Mixin: per-node log file paths to snarf at test end (db.clj:15-16)."""
+
+    def log_files(self, test, node) -> list:
+        return []
+
+
+class Noop(DB):
+    pass
+
+
+noop = Noop()
+
+
+def cycle(test) -> None:
+    """Tear down then set up the DB on all nodes concurrently; retry the
+    whole cycle up to CYCLE_TRIES times on SetupFailed (db.clj:24-67)."""
+    db = test["db"]
+    nodes = test["nodes"]
+    tries = CYCLE_TRIES
+    while True:
+        log.info("Tearing down DB")
+        def safe_teardown(node):
+            try:
+                db.teardown(test, node)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                log.warning("teardown failed on %s", node, exc_info=True)
+        real_pmap(safe_teardown, nodes)
+
+        try:
+            log.info("Setting up DB")
+            real_pmap(lambda node: db.setup(test, node), nodes)
+            if isinstance(db, Primary) and nodes:
+                log.info("Setting up primary %s", nodes[0])
+                db.setup_primary(test, nodes[0])
+            return
+        except SetupFailed:
+            tries -= 1
+            if tries <= 0:
+                raise
+            log.warning("Unable to set up database; retrying...")

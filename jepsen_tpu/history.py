@@ -317,6 +317,7 @@ class TensorHistory:
         index_: np.ndarray,
         schema: FSchema,
         process_names: dict | None = None,
+        aux: dict | None = None,
     ):
         self.process = process
         self.type = type_
@@ -327,6 +328,10 @@ class TensorHistory:
         self.schema = schema
         # encoding -> original process name, for non-int processes
         self.process_names = process_names or {}
+        # row -> original (f, value) for ops outside the schema (nemesis
+        # fs with arbitrary payloads): columns hold NIL, this restores
+        # them losslessly on decode
+        self.aux = aux or {}
 
     def __len__(self) -> int:
         return len(self.process)
@@ -344,6 +349,7 @@ class TensorHistory:
         index_ = np.empty(n, np.int64)
         names: dict = {}
         name_codes: dict = {}
+        aux: dict = {}
         for i, o in enumerate(history):
             if isinstance(o.process, int):
                 process[i] = o.process
@@ -354,11 +360,20 @@ class TensorHistory:
                 names[code] = o.process
                 process[i] = code
             type_[i] = TYPE_INDEX[o.type]
-            f[i] = schema.f_index[o.f] if o.f in schema.f_index else -1
-            value[i] = schema._encode(o.f, o.value)
+            if o.f in schema.f_index:
+                # In-schema (client) ops encode strictly: overflow raises
+                f[i] = schema.f_index[o.f]
+                value[i] = schema._encode(o.f, o.value)
+            else:
+                # Out-of-schema ops (nemesis start/stop with arbitrary
+                # payloads): columns stay NIL, original kept in aux
+                f[i] = -1
+                aux[i] = (o.f, o.value)
             time[i] = o.time
             index_[i] = o.index if o.index >= 0 else i
-        return TensorHistory(process, type_, f, value, time, index_, schema, names)
+        return TensorHistory(
+            process, type_, f, value, time, index_, schema, names, aux
+        )
 
     def decode(self) -> list[Op]:
         out = []
@@ -366,13 +381,19 @@ class TensorHistory:
             p = int(self.process[i])
             proc = self.process_names.get(p, p)
             fi = int(self.f[i])
-            fname = self.schema.fs[fi] if 0 <= fi < len(self.schema.fs) else None
+            if i in self.aux:
+                fname, val = self.aux[i]
+            elif 0 <= fi < len(self.schema.fs):
+                fname = self.schema.fs[fi]
+                val = self.schema._decode(fname, self.value[i])
+            else:
+                fname, val = None, None
             out.append(
                 Op(
                     process=proc,
                     type=TYPE_NAMES[int(self.type[i])],
                     f=fname,
-                    value=self.schema._decode(fname, self.value[i]),
+                    value=val,
                     time=int(self.time[i]),
                     index=int(self.index[i]),
                 )
@@ -380,6 +401,11 @@ class TensorHistory:
         return out
 
     def save(self, path) -> None:
+        import json
+
+        aux_json = json.dumps(
+            {str(k): [v[0], repr(v[1])] for k, v in self.aux.items()}
+        )
         np.savez_compressed(
             path,
             process=self.process,
@@ -391,19 +417,31 @@ class TensorHistory:
             fs=np.array(self.schema.fs),
             process_names_k=np.array(list(self.process_names.keys()), np.int64),
             process_names_v=np.array([str(v) for v in self.process_names.values()]),
+            aux=np.array(aux_json),
         )
 
     @staticmethod
     def load(path) -> "TensorHistory":
+        import ast
+        import json
+
         z = np.load(path, allow_pickle=False)
         schema = FSchema([str(x) for x in z["fs"]], width=z["value"].shape[1])
         names = {
             int(k): str(v)
             for k, v in zip(z["process_names_k"], z["process_names_v"])
         }
+        aux = {}
+        if "aux" in z:
+            for k, (fname, vrepr) in json.loads(str(z["aux"])).items():
+                try:
+                    val = ast.literal_eval(vrepr)
+                except (ValueError, SyntaxError):
+                    val = vrepr
+                aux[int(k)] = (fname, val)
         return TensorHistory(
             z["process"], z["type"], z["f"], z["value"], z["time"], z["index"],
-            schema, names,
+            schema, names, aux,
         )
 
 

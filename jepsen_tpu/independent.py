@@ -1,0 +1,240 @@
+"""Key-space sharding: lift single-key workloads to many independent keys
+(reference: jepsen.independent, independent.clj).
+
+This is the framework's scale-out axis (SURVEY.md SS2.4): expensive checks
+(linearizability) stay tractable because each key's subhistory is short,
+and the per-key checks are embarrassingly parallel — on the TPU path the
+keys dimension is exactly what gets vmapped/sharded across devices.
+
+Values are wrapped in KVTuple(key, v); subhistories keep every op whose
+value is NOT a tuple for a different key (so nemesis/info ops appear in
+every subhistory), unwrapping matching tuples (independent.clj:234-245).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, NamedTuple
+
+from . import generator as gen
+from .checker import Checker, check_safe, merge_valid
+from .history import op as to_op
+from .util import bounded_pmap
+
+DIR = "independent"
+
+
+class KVTuple(NamedTuple):
+    """A kv tuple (independent.clj:21-29)."""
+
+    key: object
+    value: object
+
+
+def tuple_(k, v) -> KVTuple:
+    return KVTuple(k, v)
+
+
+def is_tuple(v) -> bool:
+    return isinstance(v, KVTuple)
+
+
+def _wrap(o: dict, k) -> dict:
+    o = dict(o)
+    o["value"] = KVTuple(k, o.get("value"))
+    return o
+
+
+class SequentialGenerator(gen.Generator):
+    """One key at a time: serve ops from fgen(k1) until exhausted, then
+    move to k2 ... (independent.clj:31-64)."""
+
+    def __init__(self, keys: Iterable, fgen: Callable):
+        self._keys = iter(keys)
+        self._fgen = fgen
+        self._lock = threading.Lock()
+        self._current = None  # (k, gen)
+        self._done = False
+        self._advance()
+
+    def _advance(self):
+        try:
+            k = next(self._keys)
+            self._current = (k, gen.to_gen(self._fgen(k)))
+        except StopIteration:
+            self._current = None
+            self._done = True
+
+    def op(self, test, process):
+        while True:
+            with self._lock:
+                if self._done:
+                    return None
+                k, g = self._current
+            o = g.op(test, process)
+            if o is not None:
+                return _wrap(o, k)
+            with self._lock:
+                if not self._done and self._current[0] == k:
+                    self._advance()
+
+
+def sequential_generator(keys, fgen) -> SequentialGenerator:
+    return SequentialGenerator(keys, fgen)
+
+
+class ConcurrentGenerator(gen.Generator):
+    """n threads per key, thread-count/n keys in flight at once; each
+    group of n contiguous threads works through keys, rebinding *threads*
+    so barriers inside per-key generators span exactly that group
+    (independent.clj:66-220). Requires concurrency to be a multiple of n;
+    the nemesis never enters sub-generators."""
+
+    def __init__(self, n: int, keys: Iterable, fgen: Callable):
+        assert n > 0 and isinstance(n, int)
+        self.n = n
+        self._keys = iter(keys)
+        self._fgen = fgen
+        self._lock = threading.Lock()
+        self._state = None  # {"active": [...], "group_threads": [...]}
+
+    def _next_key(self):
+        try:
+            k = next(self._keys)
+            return (k, gen.to_gen(self._fgen(k)))
+        except StopIteration:
+            return None
+
+    def _init(self, test):
+        threads = gen.current_threads()
+        if threads is None:
+            threads = list(range(test["concurrency"]))
+        threads = [t for t in threads if isinstance(t, int)]
+        thread_count = len(threads)
+        assert sorted(threads) == list(range(thread_count)), (
+            "concurrent_generator expects integer threads 0..n"
+        )
+        group_size = self.n
+        group_count = thread_count // group_size
+        assert group_size <= thread_count, (
+            f"with {thread_count} worker threads, cannot run a key with "
+            f"{group_size} threads concurrently; raise concurrency"
+        )
+        assert thread_count == group_size * group_count, (
+            f"{thread_count} threads cannot be split into groups of "
+            f"{group_size}; make concurrency a multiple of {group_size}"
+        )
+        self._state = {
+            "active": [self._next_key() for _ in range(group_count)],
+            "group_threads": [
+                threads[g * group_size : (g + 1) * group_size]
+                for g in range(group_count)
+            ],
+        }
+
+    def op(self, test, process):
+        with self._lock:
+            if self._state is None:
+                self._init(test)
+            s = self._state
+        thread = gen.process_to_thread(test, process)
+        assert isinstance(thread, int), (
+            f"only integer worker threads may draw from "
+            f"concurrent_generator, got {thread!r}"
+        )
+        group = thread // self.n
+        while True:
+            with self._lock:
+                pair = s["active"][group]
+            if pair is None:
+                return None
+            k, g = pair
+            with gen.with_threads(s["group_threads"][group]):
+                o = g.op(test, process)
+            if o is not None:
+                return _wrap(o, k)
+            with self._lock:
+                if s["active"][group] is pair:
+                    s["active"][group] = self._next_key()
+
+
+def concurrent_generator(n, keys, fgen) -> ConcurrentGenerator:
+    return ConcurrentGenerator(n, keys, fgen)
+
+
+def history_keys(history) -> set:
+    """All keys appearing in tuple values (independent.clj:222-232)."""
+    out = set()
+    for o in history:
+        v = to_op(o).value
+        if is_tuple(v):
+            out.add(v.key)
+    return out
+
+
+def subhistory(k, history) -> list:
+    """Ops without a *different* key's tuple value, tuples unwrapped
+    (independent.clj:234-245)."""
+    out = []
+    for o in history:
+        o = to_op(o)
+        v = o.value
+        if not is_tuple(v):
+            out.append(o)
+        elif v.key == k:
+            out.append(o.with_(value=v.value))
+    return out
+
+
+class IndependentChecker(Checker):
+    """Lift a checker over v to one over [k v] tuples: check each key's
+    subhistory (in parallel), merge validities, list failing keys
+    (independent.clj:247-298)."""
+
+    def __init__(self, checker: Checker):
+        self.checker = checker
+
+    def check(self, test, history, opts=None) -> dict:
+        opts = dict(opts or {})
+        history = list(history)
+        ks = sorted(history_keys(history), key=str)
+
+        def check_key(k):
+            sub = subhistory(k, history)
+            subdir = list(opts.get("subdirectory") or []) + [DIR, str(k)]
+            r = check_safe(
+                self.checker,
+                test,
+                sub,
+                {**opts, "subdirectory": subdir, "history_key": k},
+            )
+            self._write_artifacts(test, subdir, sub, r)
+            return k, r
+
+        results = dict(bounded_pmap(check_key, ks))
+        # Only definite falsifications are failures; "unknown" keys are
+        # excluded, as in the reference (independent.clj:283-291, where
+        # :unknown is truthy)
+        failures = [k for k, r in results.items() if r["valid"] is False]
+        return {
+            "valid": merge_valid(r["valid"] for r in results.values()),
+            "results": results,
+            "failures": failures,
+        }
+
+    @staticmethod
+    def _write_artifacts(test, subdir, sub, result) -> None:
+        """Persist per-key history + results under the test's store dir
+        (independent.clj:269-287), when a store is attached."""
+        try:
+            from . import store
+
+            if test and test.get("start_time"):
+                store.write_edn(test, subdir + ["results.edn"], result)
+                store.write_history_txt(test, subdir + ["history.txt"], sub)
+        except Exception:  # noqa: BLE001 - artifact writing is best-effort
+            pass
+
+
+def checker(c: Checker) -> IndependentChecker:
+    return IndependentChecker(c)

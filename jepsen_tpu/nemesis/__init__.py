@@ -1,0 +1,300 @@
+"""Fault injection (reference: jepsen.nemesis, nemesis.clj).
+
+A nemesis is a special client driven by the nemesis worker thread: it
+receives ops from the generator (routed via gen.nemesis) and perturbs the
+cluster — partitions, clock skew, process kills, file corruption. Grudge
+builders (which nodes stop talking to which) are pure functions, tested
+without any cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import random as _random
+from typing import Callable, Iterable, Mapping
+
+from ..history import Op
+from ..util import majority, real_pmap
+
+log = logging.getLogger("jepsen_tpu.nemesis")
+
+
+class Nemesis:
+    """Lifecycle mirror of nemesis.clj:9-14."""
+
+    def setup(self, test) -> "Nemesis":
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        pass
+
+
+class Noop(Nemesis):
+    """Does nothing (nemesis.clj:198-201): still completes ops so
+    generators advance."""
+
+    def invoke(self, test, op):
+        return op.with_(type="info")
+
+
+noop = Noop()
+
+
+# ---------------------------------------------------------------------------
+# Grudges: pure partition math (nemesis.clj:56-156)
+
+def bisect(coll: Iterable) -> tuple[list, list]:
+    """Split a collection into two halves, first half smaller
+    (nemesis.clj:56-62)."""
+    coll = list(coll)
+    mid = len(coll) // 2
+    return coll[:mid], coll[mid:]
+
+
+def split_one(coll: Iterable, node=None) -> tuple[list, list]:
+    """Isolate one node (the given one, or random) from the rest
+    (nemesis.clj:64-73)."""
+    coll = list(coll)
+    node = node if node is not None else _random.choice(coll)
+    return [node], [n for n in coll if n != node]
+
+
+def complete_grudge(components: Iterable[Iterable]) -> dict:
+    """From a partition into components, build the grudge: node -> set of
+    nodes it cannot talk to (everything outside its component)
+    (nemesis.clj:75-87)."""
+    components = [list(c) for c in components]
+    everyone = {n for c in components for n in c}
+    grudge = {}
+    for c in components:
+        others = everyone - set(c)
+        for n in c:
+            grudge[n] = set(others)
+    return grudge
+
+
+def bridge(nodes: Iterable) -> dict:
+    """Grudge with a bridge node connected to both halves: majorities
+    overlap on one node (nemesis.clj:89-99)."""
+    nodes = list(nodes)
+    mid = len(nodes) // 2
+    head, bridge_node, tail = nodes[:mid], nodes[mid], nodes[mid + 1 :]
+    grudge = {n: set(tail) for n in head}
+    grudge.update({n: set(head) for n in tail})
+    grudge[bridge_node] = set()
+    return grudge
+
+
+def majorities_ring(nodes: Iterable) -> dict:
+    """Every node sees a majority, but no two nodes see the same majority
+    (nemesis.clj:134-147): node i is connected to the majority-sized
+    window of the (shuffled) ring starting at its position."""
+    nodes = list(nodes)
+    n = len(nodes)
+    ring = list(nodes)
+    _random.shuffle(ring)
+    m = majority(n)
+    grudge = {}
+    for i, node in enumerate(ring):
+        visible = {ring[(i + d) % n] for d in range(m)}
+        grudge[node] = set(nodes) - visible
+    return grudge
+
+
+# ---------------------------------------------------------------------------
+# Partitioners (nemesis.clj:95-156)
+
+class Partitioner(Nemesis):
+    """Responds to {:f "start"} by cutting links per grudge(nodes), and
+    {:f "stop"} by healing (nemesis.clj:95-116)."""
+
+    def __init__(self, grudge_fn: Callable[[list], Mapping]):
+        self.grudge_fn = grudge_fn
+
+    def setup(self, test):
+        test["net"].heal(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            grudge = (
+                op.value
+                if isinstance(op.value, Mapping)
+                else self.grudge_fn(list(test["nodes"]))
+            )
+            test["net"].drop_all(test, grudge)
+            return op.with_(
+                type="info", value=f"Cut off {_render_grudge(grudge)}"
+            )
+        if op.f == "stop":
+            test["net"].heal(test)
+            return op.with_(type="info", value="fully connected")
+        raise ValueError(f"partitioner can't handle op {op.f!r}")
+
+    def teardown(self, test):
+        test["net"].heal(test)
+
+
+def _render_grudge(grudge: Mapping) -> dict:
+    return {n: sorted(v) for n, v in grudge.items() if v}
+
+
+def partitioner(grudge_fn) -> Partitioner:
+    return Partitioner(grudge_fn)
+
+
+def partition_halves() -> Partitioner:
+    """Cut the network into two halves, first node in the smaller one
+    (nemesis.clj:118-124)."""
+    return Partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+
+
+def partition_random_halves() -> Partitioner:
+    """Two RANDOM halves (nemesis.clj:126-132)."""
+
+    def grudge(nodes):
+        nodes = list(nodes)
+        _random.shuffle(nodes)
+        return complete_grudge(bisect(nodes))
+
+    return Partitioner(grudge)
+
+
+def partition_random_node() -> Partitioner:
+    """Isolate a single random node (nemesis.clj:107-116 via split-one)."""
+    return Partitioner(lambda nodes: complete_grudge(split_one(nodes)))
+
+
+def partition_majorities_ring() -> Partitioner:
+    """Intersecting majorities ring partition (nemesis.clj:149-156)."""
+    return Partitioner(majorities_ring)
+
+
+# ---------------------------------------------------------------------------
+# Composition & process nemeses
+
+class Compose(Nemesis):
+    """Route ops to sub-nemeses by :f. Takes {fs_or_fmap: nemesis, ...}
+    where the key is a set of fs, or a dict mapping outer f -> inner f
+    (nemesis.clj:158-196)."""
+
+    def __init__(self, nemeses: Mapping):
+        self.nemeses = dict(nemeses)
+
+    def setup(self, test):
+        self.nemeses = {
+            fs: nem.setup(test) for fs, nem in self.nemeses.items()
+        }
+        return self
+
+    def _route(self, f):
+        for fs, nem in self.nemeses.items():
+            if isinstance(fs, Mapping):
+                if f in fs:
+                    return nem, fs[f]
+            elif f in fs:
+                return nem, f
+        raise ValueError(f"no nemesis can handle {f!r}")
+
+    def invoke(self, test, op):
+        nem, inner_f = self._route(op.f)
+        outer_f = op.f
+        completion = nem.invoke(test, op.with_(f=inner_f))
+        return completion.with_(f=outer_f)
+
+    def teardown(self, test):
+        for nem in self.nemeses.values():
+            nem.teardown(test)
+
+
+def compose(nemeses: Mapping) -> Compose:
+    return Compose(nemeses)
+
+
+class NodeStartStopper(Nemesis):
+    """On "start", run stop_fn on some targeted nodes (e.g. kill the DB);
+    on "stop", run start_fn to revive them (nemesis.clj:220-263).
+    targeter: nodes -> node collection."""
+
+    def __init__(self, targeter, stop_fn, start_fn):
+        self.targeter = targeter
+        self.stop_fn = stop_fn
+        self.start_fn = start_fn
+        self.affected: list = []
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            if self.affected:
+                return op.with_(type="info", value="already affecting nodes")
+            targets = list(self.targeter(list(test["nodes"])))
+            res = dict(
+                zip(
+                    targets,
+                    real_pmap(lambda n: self.stop_fn(test, n), targets),
+                )
+            )
+            self.affected = targets
+            return op.with_(type="info", value=res)
+        if op.f == "stop":
+            targets = self.affected
+            res = dict(
+                zip(
+                    targets,
+                    real_pmap(lambda n: self.start_fn(test, n), targets),
+                )
+            )
+            self.affected = []
+            return op.with_(type="info", value=res)
+        raise ValueError(f"node_start_stopper can't handle {op.f!r}")
+
+
+def node_start_stopper(targeter, stop_fn, start_fn) -> NodeStartStopper:
+    return NodeStartStopper(targeter, stop_fn, start_fn)
+
+
+def hammer_time(process_name: str, targeter=None) -> NodeStartStopper:
+    """SIGSTOP/SIGCONT a process on targeted nodes — pause without kill
+    (nemesis.clj:265-279)."""
+    targeter = targeter or (lambda nodes: [_random.choice(nodes)])
+
+    def stop(test, node):
+        test["remote"].exec(
+            node, ["killall", "-s", "STOP", process_name], sudo=True
+        )
+        return "paused"
+
+    def start(test, node):
+        test["remote"].exec(
+            node, ["killall", "-s", "CONT", process_name], sudo=True
+        )
+        return "resumed"
+
+    return NodeStartStopper(targeter, stop, start)
+
+
+class TruncateFile(Nemesis):
+    """Truncate a file by a few bytes on targeted nodes — torn-write
+    corruption (nemesis.clj:281-307)."""
+
+    def __init__(self, path: str, drop_bytes: int = 1, targeter=None):
+        self.path = path
+        self.drop_bytes = drop_bytes
+        self.targeter = targeter or (lambda nodes: [_random.choice(nodes)])
+
+    def invoke(self, test, op):
+        assert op.f == "truncate"
+        targets = list(self.targeter(list(test["nodes"])))
+        for node in targets:
+            test["remote"].exec(
+                node,
+                ["truncate", "-c", "-s", f"-{self.drop_bytes}", self.path],
+                sudo=True,
+            )
+        return op.with_(type="info", value={"truncated": targets})
+
+
+def truncate_file(path, drop_bytes=1, targeter=None) -> TruncateFile:
+    return TruncateFile(path, drop_bytes, targeter)

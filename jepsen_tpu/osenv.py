@@ -1,0 +1,19 @@
+"""Operating-system provisioning protocol (reference: jepsen.os,
+os.clj:4-14). Concrete distro implementations live in osdist.py."""
+
+from __future__ import annotations
+
+
+class OS:
+    def setup(self, test, node) -> None:
+        """Prepare the operating system on this node."""
+
+    def teardown(self, test, node) -> None:
+        """Clean up whatever setup did."""
+
+
+class Noop(OS):
+    pass
+
+
+noop = Noop()

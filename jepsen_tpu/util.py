@@ -127,6 +127,42 @@ def timeout(seconds: float, fn: Callable[[], Any], default: Any = TimeoutError_)
     return result[0]
 
 
+_op_log = None
+
+
+def log_op_logger(op) -> None:
+    """Log an op at debug level (util.clj:208-212, called from
+    core.clj:383,409)."""
+    global _op_log
+    if _op_log is None:
+        import logging
+
+        _op_log = logging.getLogger("jepsen_tpu.ops")
+    _op_log.debug("%s", op)
+
+
+class CountDownLatch:
+    """A latch: count_down() decrements; await_() blocks until zero
+    (the JVM CountDownLatch used for worker phase gates, core.clj:174-225)."""
+
+    def __init__(self, count: int):
+        self._count = count
+        self._cond = threading.Condition()
+
+    def count_down(self) -> None:
+        with self._cond:
+            if self._count > 0:
+                self._count -= 1
+                if self._count == 0:
+                    self._cond.notify_all()
+
+    def await_(self, timeout: float | None = None) -> bool:
+        with self._cond:
+            if self._count == 0:
+                return True
+            return self._cond.wait_for(lambda: self._count == 0, timeout)
+
+
 # ---------------------------------------------------------------------------
 # Relative time (util.clj:271-288)
 
@@ -245,23 +281,25 @@ def history_latencies(history) -> list:
 
 
 def nemesis_intervals(history, start_fs=("start",), stop_fs=("stop",)) -> list:
-    """Pairs of [start-op, stop-op] delimiting nemesis activity windows
-    (util.clj:634-651). Unclosed windows get a None stop."""
+    """Pairs of (start-op, stop-op) delimiting nemesis activity windows
+    (util.clj:634-651). Histories interleave invocations and completions
+    (start start stop stop), so each stop pairs FIFO with the oldest
+    unpaired start; unclosed windows get a None stop."""
     from .history import op as to_op  # local import to avoid cycle
 
-    out = []
-    current = None
+    import collections
+
+    pairs = []
+    starts: collections.deque = collections.deque()
     for op in map(to_op, history):
-        if op.process != "nemesis" or not op.is_invoke:
+        if op.process != "nemesis":
             continue
-        if op.f in start_fs and current is None:
-            current = op
-        elif op.f in stop_fs and current is not None:
-            out.append((current, op))
-            current = None
-    if current is not None:
-        out.append((current, None))
-    return out
+        if op.f in start_fs:
+            starts.append(op)
+        elif op.f in stop_fs and starts:
+            pairs.append((starts.popleft(), op))
+    pairs.extend((s, None) for s in starts)
+    return pairs
 
 
 def rand_exp(mean: float, rng=None) -> float:

@@ -1,6 +1,11 @@
 """Test environment: force JAX onto CPU with 8 virtual devices so all
 mesh/sharding tests run without TPU hardware (the driver separately
-dry-runs the multi-chip path; see __graft_entry__.py)."""
+dry-runs the multi-chip path; see __graft_entry__.py).
+
+Note: the env var alone is NOT enough in this image — a sitecustomize
+registers an experimental TPU platform plugin and resets jax_platforms,
+and initializing that backend can hang when the TPU tunnel is down. The
+config.update below takes precedence and keeps tests hermetic."""
 
 import os
 
@@ -10,3 +15,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

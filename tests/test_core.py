@@ -1,0 +1,147 @@
+"""Engine tests against the in-process fake backend (reference:
+jepsen/test/jepsen/core_test.clj — basic-cas-test, worker crash recovery,
+generator exception propagation)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, nemesis as nemesis_mod
+from jepsen_tpu.checker import linearizable
+from jepsen_tpu.history import Op
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.testlib import (
+    AtomClient,
+    AtomDB,
+    FlakyClient,
+    SharedAtom,
+    cas_test,
+    noop_test,
+)
+
+
+class TestBasicCas:
+    def test_full_engine_run(self):
+        state = SharedAtom()
+        test = core.run(cas_test(state))
+        r = test["results"]
+        assert r["valid"] is True, r
+        hist = test["history"]
+        assert len(hist) > 50
+        # every op indexed monotonically
+        assert [o.index for o in hist] == list(range(len(hist)))
+        # invocations pair with completions
+        invokes = [o for o in hist if o.is_invoke]
+        assert invokes
+        # db was set up then torn down
+        assert state.value == "done"
+
+    def test_history_valid_under_crashes(self):
+        state = SharedAtom()
+        test = cas_test(state, client=FlakyClient(state, crash_p=0.15))
+        test = core.run(test)
+        # crashes applied the op, so the history must STILL be
+        # linearizable; crashed ops become :info and processes reincarnate
+        assert test["results"]["valid"] is True, test["results"]
+        infos = [o for o in test["history"] if o.is_info and o.process != "nemesis"]
+        assert infos, "expected some crashed ops"
+        # reincarnation: some process ids exceed concurrency
+        procs = {o.process for o in test["history"] if isinstance(o.process, int)}
+        assert any(p >= test["concurrency"] for p in procs)
+
+    def test_process_stays_single_threaded(self):
+        state = SharedAtom()
+        test = core.run(
+            cas_test(state, client=FlakyClient(state, crash_p=0.2))
+        )
+        # No process may invoke twice without completing: pairs() raises
+        from jepsen_tpu.history import pairs
+
+        pairs([o for o in test["history"] if isinstance(o.process, int)])
+
+
+class TestWorkerFailure:
+    def test_generator_exception_propagates(self):
+        class BoomGen(gen.Generator):
+            def op(self, test, process):
+                raise RuntimeError("generator boom")
+
+        test = noop_test()
+        test.update(
+            {
+                "name": None,
+                "generator": gen.clients(BoomGen()),
+                "nodes": ["n1"],
+            }
+        )
+        with pytest.raises(RuntimeError, match="generator boom"):
+            core.run(test)
+
+    def test_client_open_failure_records_fail_ops(self):
+        class BrokenClient(AtomClient):
+            """Initial opens (worker setup) succeed; invokes crash; every
+            re-open after a crash fails -> :fail (no-client) ops."""
+
+            def __init__(self, state, budget):
+                super().__init__(state)
+                self.opens = 0
+                self.budget = budget
+                self.lock = threading.Lock()
+
+            def open(self, test, node):
+                with self.lock:
+                    self.opens += 1
+                    if self.opens > self.budget:
+                        raise RuntimeError("cannot reconnect")
+                return self
+
+            def close(self, test):
+                pass
+
+            def invoke(self, test, op):
+                raise RuntimeError("connection lost")
+
+        state = SharedAtom()
+        test = cas_test(state)
+        test["client"] = BrokenClient(state, budget=len(test["nodes"]))
+        test["generator"] = gen.clients(gen.limit(10, gen.cas))
+        test = core.run(test)
+        hist = test["history"]
+        fails = [o for o in hist if o.is_fail and o.error]
+        infos = [o for o in hist if o.is_info and isinstance(o.process, int)]
+        # first invokes crash (:info), then reopening fails (:fail no-client)
+        assert infos
+        assert fails
+
+
+class TestNemesisJournaling:
+    def test_nemesis_ops_in_history(self):
+        class CountingNemesis(nemesis_mod.Nemesis):
+            def invoke(self, test, op):
+                return op.with_(type="info", value="did-something")
+
+        test = cas_test()
+        test["nemesis"] = CountingNemesis()
+        test["generator"] = gen.nemesis(
+            gen.limit(3, {"f": "poke", "type": "info"}),
+            gen.limit(20, gen.cas),
+        )
+        test = core.run(test)
+        nem_ops = [o for o in test["history"] if o.process == "nemesis"]
+        # 3 invocations + 3 completions
+        assert len(nem_ops) == 6
+        assert test["results"]["valid"] is True
+
+
+class TestDeterminacyRules:
+    def test_failed_ops_recorded_as_fail(self):
+        state = SharedAtom()
+        test = cas_test(state)
+        test["generator"] = gen.clients(
+            gen.limit(30, {"f": "cas", "value": (3, 4), "type": "invoke"})
+        )
+        test = core.run(test)
+        # register starts None; all CAS(3,4) must fail deterministically
+        fails = [o for o in test["history"] if o.is_fail]
+        assert fails
+        assert test["results"]["valid"] is True

@@ -1,0 +1,274 @@
+"""Generator DSL tests (reference: jepsen/test/jepsen/generator_test.clj —
+drive generators with symbolic processes/threads and collect emitted ops)."""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import generator as gen
+
+TEST = {"concurrency": 4, "nodes": ["n1", "n2", "n3", "n4", "n5"]}
+
+
+def drain(g, test=TEST, process=0, cap=10_000):
+    """Pull ops until exhaustion."""
+    g = gen.to_gen(g)
+    out = []
+    for _ in range(cap):
+        o = g.op(test, process)
+        if o is None:
+            return out
+        out.append(o)
+    raise AssertionError("generator did not terminate")
+
+
+class TestCoercions:
+    def test_none_is_void(self):
+        assert gen.to_gen(None).op(TEST, 0) is None
+
+    def test_dict_repeats(self):
+        g = gen.to_gen({"f": "read"})
+        assert g.op(TEST, 0) == {"f": "read"}
+        assert g.op(TEST, 0) == {"f": "read"}
+
+    def test_callable(self):
+        g = gen.to_gen(lambda: {"f": "x"})
+        assert g.op(TEST, 0) == {"f": "x"}
+        g2 = gen.to_gen(lambda test, process: {"f": "y", "value": process})
+        assert g2.op(TEST, 7) == {"f": "y", "value": 7}
+
+    def test_validate(self):
+        with pytest.raises(gen.InvalidOp):
+            gen.op_and_validate(lambda: 42, TEST, 0)
+
+
+class TestBasicCombinators:
+    def test_once(self):
+        assert drain(gen.once({"f": "read"})) == [{"f": "read"}]
+
+    def test_limit(self):
+        assert len(drain(gen.limit(5, {"f": "read"}))) == 5
+
+    def test_seq_advances_per_op(self):
+        g = gen.seq([{"f": "a"}, {"f": "b"}, {"f": "c"}])
+        assert [o["f"] for o in drain(g)] == ["a", "b", "c"]
+
+    def test_seq_skips_nil(self):
+        g = gen.seq([{"f": "a"}, None, {"f": "b"}])
+        assert [o["f"] for o in drain(g)] == ["a", "b"]
+
+    def test_f_map(self):
+        g = gen.f_map({"read": "txn-read"}, gen.once({"f": "read"}))
+        assert drain(g) == [{"f": "txn-read"}]
+
+    def test_filter(self):
+        g = gen.filter_gen(
+            lambda o: o["f"] == "a",
+            gen.seq([{"f": "a"}, {"f": "b"}, {"f": "a"}]),
+        )
+        assert [o["f"] for o in drain(g)] == ["a", "a"]
+
+    def test_mix(self):
+        g = gen.mix([{"f": "a"}, {"f": "b"}])
+        fs = {g.op(TEST, 0)["f"] for _ in range(50)}
+        assert fs == {"a", "b"}
+
+    def test_each_gives_fresh_generators(self):
+        g = gen.each(lambda: gen.once({"f": "x"}))
+        assert g.op(TEST, 0) == {"f": "x"}
+        assert g.op(TEST, 0) is None
+        assert g.op(TEST, 1) == {"f": "x"}  # fresh for process 1
+
+    def test_drain_queue(self):
+        g = gen.drain_queue(
+            gen.seq([{"f": "enqueue", "value": 1}, {"f": "enqueue", "value": 2}])
+        )
+        ops = drain(g)
+        assert [o["f"] for o in ops] == ["enqueue", "enqueue", "dequeue", "dequeue"]
+
+
+class TestTiming:
+    def test_delay(self):
+        g = gen.delay(0.05, gen.limit(2, {"f": "read"}))
+        t0 = time.monotonic()
+        drain(g)
+        assert time.monotonic() - t0 >= 0.1
+
+    def test_stagger_bounded(self):
+        g = gen.stagger(0.01, gen.limit(5, {"f": "read"}))
+        t0 = time.monotonic()
+        drain(g)
+        assert time.monotonic() - t0 < 5 * 0.02 + 0.5
+
+    def test_time_limit(self):
+        g = gen.time_limit(0.1, {"f": "read"})
+        t0 = time.monotonic()
+        n = len(drain(g, cap=1_000_000))
+        assert 0.05 <= time.monotonic() - t0 < 2.0
+        assert n > 0
+
+    def test_delay_til_alignment(self):
+        g = gen.delay_til(0.05, gen.limit(3, {"f": "read"}), precache=False)
+        times = []
+        gg = gen.to_gen(g)
+        while gg.op(TEST, 0) is not None:
+            times.append(time.monotonic())
+        # consecutive ops should be ~multiples of 0.05 apart
+        deltas = [b - a for a, b in zip(times, times[1:])]
+        for d in deltas:
+            assert abs(d - 0.05) < 0.04 or abs(d - 0.1) < 0.04
+
+
+class TestRouting:
+    def test_concat_per_process(self):
+        g = gen.concat(gen.once({"f": "a"}), gen.once({"f": "b"}))
+        assert g.op(TEST, 0)["f"] == "a"
+        assert g.op(TEST, 0)["f"] == "b"
+        assert g.op(TEST, 0) is None
+
+    def test_nemesis_routing(self):
+        g = gen.nemesis(
+            gen.once({"f": "start"}), gen.once({"f": "read"})
+        )
+        assert g.op(TEST, "nemesis")["f"] == "start"
+        assert g.op(TEST, 0)["f"] == "read"
+        assert g.op(TEST, 0) is None
+
+    def test_clients_blocks_nemesis(self):
+        g = gen.clients({"f": "read"})
+        assert g.op(TEST, "nemesis") is None
+        assert g.op(TEST, 2)["f"] == "read"
+
+    def test_on_wraps_reincarnated_processes(self):
+        g = gen.clients({"f": "read"})
+        # process 6 -> thread 2 with concurrency 4
+        assert g.op(TEST, 6)["f"] == "read"
+
+    def test_reserve(self):
+        g = gen.reserve(2, {"f": "w"}, {"f": "r"})
+        with gen.with_threads([0, 1, 2, 3]):
+            assert g.op(TEST, 0)["f"] == "w"
+            assert g.op(TEST, 1)["f"] == "w"
+            assert g.op(TEST, 2)["f"] == "r"
+            assert g.op(TEST, 3)["f"] == "r"
+            # reincarnated process 7 -> thread 3
+            assert g.op(TEST, 7)["f"] == "r"
+
+    def test_reserve_rebinds_threads(self):
+        captured = {}
+
+        def probe(test, process):
+            captured[process] = gen.current_threads()
+            return None
+
+        g = gen.reserve(2, probe, probe)
+        with gen.with_threads([0, 1, 2, 3]):
+            g.op(TEST, 0)
+            g.op(TEST, 3)
+        assert captured[0] == [0, 1]
+        assert captured[3] == [2, 3]
+
+
+class TestSynchronization:
+    def test_synchronize_blocks_until_all_arrive(self):
+        test = {"concurrency": 3, "nodes": ["a"]}
+        g = gen.phases(
+            gen.each(lambda: gen.once({"f": "p1"})),
+            gen.each(lambda: gen.once({"f": "p2"})),
+        )
+        results = {}
+        order = []
+        lock = threading.Lock()
+
+        def worker(p, delay):
+            with gen.with_threads([0, 1, 2]):
+                ops = []
+                time.sleep(delay)
+                while True:
+                    o = g.op(test, p)
+                    if o is None:
+                        break
+                    ops.append(o["f"])
+                    with lock:
+                        order.append((p, o["f"]))
+                results[p] = ops
+
+        ts = [
+            threading.Thread(target=worker, args=(p, p * 0.03))
+            for p in range(3)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert all(results[p] == ["p1", "p2"] for p in range(3))
+        # no p2 may be emitted before every p1
+        p1_seen = 0
+        for _, f in order:
+            if f == "p1":
+                p1_seen += 1
+            else:
+                assert p1_seen == 3
+
+    def test_await(self):
+        flag = []
+        g = gen.await_fn(lambda: flag.append(1), gen.once({"f": "x"}))
+        assert g.op(TEST, 0)["f"] == "x"
+        assert flag == [1]
+
+    def test_barrier_completes(self):
+        test = {"concurrency": 1, "nodes": ["a"]}
+        g = gen.barrier(gen.once({"f": "x"}))
+        with gen.with_threads([0]):
+            assert g.op(test, 0)["f"] == "x"
+            assert g.op(test, 0) is None
+
+
+class TestProcessMapping:
+    def test_process_to_thread(self):
+        assert gen.process_to_thread(TEST, 6) == 2
+        assert gen.process_to_thread(TEST, "nemesis") == "nemesis"
+
+    def test_process_to_node(self):
+        assert gen.process_to_node(TEST, 0) == "n1"
+        assert gen.process_to_node(TEST, 6) == "n3"
+        assert gen.process_to_node(TEST, "nemesis") is None
+
+
+class TestReviewRegressions:
+    def test_fngen_inner_typeerror_propagates(self):
+        def bad(test, process):
+            raise TypeError("inner boom")
+
+        with pytest.raises(TypeError, match="inner boom"):
+            gen.to_gen(bad).op(TEST, 0)
+
+    def test_abort_breaks_synchronize_barrier(self):
+        """A worker dying mid-phases must not deadlock the others."""
+        from jepsen_tpu import core
+        from jepsen_tpu.testlib import cas_test
+
+        class BoomOnce(gen.Generator):
+            def __init__(self):
+                self.fired = False
+                self.lock = threading.Lock()
+
+            def op(self, test, process):
+                with self.lock:
+                    if not self.fired:
+                        self.fired = True
+                        raise RuntimeError("worker death")
+                return None
+
+        test = cas_test()
+        test["name"] = None
+        # phase 1: one worker dies immediately; others hit the phase-2
+        # barrier and must be woken by the abort
+        test["generator"] = gen.clients(
+            gen.phases(BoomOnce(), gen.each(lambda: gen.once({"f": "read"})))
+        )
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="worker death"):
+            core.run(test)
+        assert time.monotonic() - t0 < 30  # no deadlock

@@ -163,3 +163,34 @@ def test_encode_rejects_sentinel_collision():
     s = FSchema(["write"], width=1)
     with pytest.raises(OverflowError):
         s._encode("write", 2**62)
+
+
+def test_encode_full_engine_history_with_nemesis_payloads():
+    """Nemesis completions carry arbitrary (string/dict) values; the
+    tensor encoding must round-trip them via the aux table."""
+    hist = h(
+        invoke_op(0, "write", 1),
+        Op("nemesis", "info", "start", None, time=1),
+        Op("nemesis", "info", "start", "Cut off {'n1': ['n2']}", time=2),
+        ok_op(0, "write", 1),
+        Op("nemesis", "info", "stop", {"healed": True}, time=3),
+    )
+    t = TensorHistory.encode(hist, REGISTER_SCHEMA)
+    back = t.decode()
+    assert back[2].value == "Cut off {'n1': ['n2']}"
+    assert back[4].value == {"healed": True}
+    assert back[0].value == 1
+
+
+def test_encode_aux_save_load(tmp_path):
+    hist = h(
+        Op("nemesis", "info", "start", "some payload"),
+        invoke_op(0, "read"),
+        ok_op(0, "read", 5),
+    )
+    t = TensorHistory.encode(hist)
+    p = tmp_path / "h.npz"
+    t.save(p)
+    back = TensorHistory.load(p).decode()
+    assert back[0].value == "some payload"
+    assert back[2].value == 5

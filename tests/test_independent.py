@@ -1,0 +1,143 @@
+"""independent key-sharding tests (reference: jepsen.independent)."""
+
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import linearizable, set_checker
+from jepsen_tpu.history import index, invoke_op, ok_op
+from jepsen_tpu.independent import KVTuple, tuple_
+from jepsen_tpu.models import CASRegister
+
+TEST = {"concurrency": 4, "nodes": ["a", "b"]}
+
+
+class TestSequentialGenerator:
+    def test_wraps_and_advances(self):
+        g = independent.sequential_generator(
+            ["x", "y"], lambda k: gen.limit(2, {"f": "read"})
+        )
+        ops = []
+        while True:
+            o = g.op(TEST, 0)
+            if o is None:
+                break
+            ops.append(o)
+        assert [o["value"] for o in ops] == [
+            KVTuple("x", None),
+            KVTuple("x", None),
+            KVTuple("y", None),
+            KVTuple("y", None),
+        ]
+
+    def test_empty_keys(self):
+        g = independent.sequential_generator([], lambda k: {"f": "read"})
+        assert g.op(TEST, 0) is None
+
+
+class TestConcurrentGenerator:
+    def test_groups_work_distinct_keys(self):
+        test = {"concurrency": 4, "nodes": ["a"]}
+        g = independent.concurrent_generator(
+            2, ["k0", "k1", "k2"], lambda k: gen.limit(4, {"f": "read"})
+        )
+        seen = {}
+        lock = threading.Lock()
+
+        def worker(thread):
+            with gen.with_threads([0, 1, 2, 3]):
+                while True:
+                    o = g.op(test, thread)
+                    if o is None:
+                        return
+                    with lock:
+                        seen.setdefault(thread, []).append(o["value"].key)
+
+        ts = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        # group 0 = threads 0,1; group 1 = threads 2,3. Each key is served
+        # to exactly one group.
+        group_of_key = {}
+        for thread, keys in seen.items():
+            for k in keys:
+                group_of_key.setdefault(k, set()).add(thread // 2)
+        for k, groups in group_of_key.items():
+            assert len(groups) == 1, (k, groups)
+        # all 3 keys got served, 4 ops each
+        total = sum(len(v) for v in seen.values())
+        assert total == 12
+
+    def test_rejects_nemesis(self):
+        g = independent.concurrent_generator(1, ["k"], lambda k: {"f": "r"})
+        try:
+            with gen.with_threads([0, 1, 2, 3]):
+                g.op(TEST, "nemesis")
+            raise AssertionError("expected AssertionError")
+        except AssertionError:
+            pass
+
+
+class TestSubhistories:
+    def hist(self):
+        return index(
+            [
+                invoke_op(0, "write", tuple_("k1", 1)),
+                ok_op(0, "write", tuple_("k1", 1)),
+                invoke_op(1, "write", tuple_("k2", 5)),
+                invoke_op("nemesis", "start", None),
+                ok_op(1, "write", tuple_("k2", 5)),
+                invoke_op(0, "read", tuple_("k1", None)),
+                ok_op(0, "read", tuple_("k1", 1)),
+            ]
+        )
+
+    def test_history_keys(self):
+        assert independent.history_keys(self.hist()) == {"k1", "k2"}
+
+    def test_subhistory_unwraps_and_keeps_untupled(self):
+        sub = independent.subhistory("k1", self.hist())
+        assert [o.value for o in sub if o.f != "start"] == [1, 1, None, 1]
+        # nemesis op (non-tuple value) retained
+        assert any(o.process == "nemesis" for o in sub)
+
+    def test_independent_checker(self):
+        c = independent.checker(linearizable(CASRegister(), algorithm="host"))
+        r = c.check({}, self.hist(), {})
+        assert r["valid"] is True
+        assert set(r["results"].keys()) == {"k1", "k2"}
+        assert r["failures"] == []
+
+    def test_independent_checker_flags_bad_key(self):
+        bad = self.hist() + index(
+            [
+                invoke_op(2, "read", tuple_("k2", None)),
+                ok_op(2, "read", tuple_("k2", 999)),
+            ]
+        )
+        for i, o in enumerate(bad):
+            o.index = i
+        c = independent.checker(linearizable(CASRegister(), algorithm="host"))
+        r = c.check({}, bad, {})
+        assert r["valid"] is False
+        assert r["failures"] == ["k2"]
+        assert r["results"]["k1"]["valid"] is True
+
+
+def test_unknown_keys_are_not_failures():
+    """Timed-out (unknown) keys must not be reported as failures
+    (independent.clj:283-291: :unknown is truthy)."""
+    from jepsen_tpu.checker import Checker
+
+    class UnknownChecker(Checker):
+        def check(self, test, history, opts=None):
+            return {"valid": "unknown"}
+
+    hist = index(
+        [invoke_op(0, "write", tuple_("k1", 1)), ok_op(0, "write", tuple_("k1", 1))]
+    )
+    r = independent.checker(UnknownChecker()).check({}, hist, {})
+    assert r["valid"] == "unknown"
+    assert r["failures"] == []

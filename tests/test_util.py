@@ -77,5 +77,25 @@ def test_nemesis_intervals():
         {"process": "nemesis", "type": "invoke", "f": "stop", "time": 9},
     ]
     iv = nemesis_intervals(hist)
-    assert len(iv) == 1
+    # stop pairs FIFO with the oldest start; the unmatched completion
+    # start remains open (util.clj:634-651)
+    assert len(iv) == 2
     assert iv[0][0].time == 1 and iv[0][1].time == 9
+    assert iv[1][0].time == 2 and iv[1][1] is None
+
+
+def test_nemesis_intervals_info_typed_ops():
+    """Engine nemesis ops are all type=info, interleaved
+    start,start,stop,stop; stops pair FIFO with starts (util.clj:634-651)."""
+    hist = [
+        {"process": "nemesis", "type": "info", "f": "start", "time": 1},
+        {"process": "nemesis", "type": "info", "f": "start", "time": 2},
+        {"process": "nemesis", "type": "info", "f": "stop", "time": 9},
+        {"process": "nemesis", "type": "info", "f": "stop", "time": 10},
+        {"process": "nemesis", "type": "info", "f": "start", "time": 20},
+    ]
+    iv = nemesis_intervals(hist)
+    assert len(iv) == 3
+    assert (iv[0][0].time, iv[0][1].time) == (1, 9)
+    assert (iv[1][0].time, iv[1][1].time) == (2, 10)
+    assert iv[2] == (iv[2][0], None) and iv[2][0].time == 20
