@@ -1,0 +1,110 @@
+"""Benchmark: the BASELINE.json north-star config.
+
+A 10k-op, 5-client-per-key CAS-register history (the etcd workload shape:
+~300 ops/key over ~34 independent keys, etcd.clj:167-173) checked for
+linearizability by the TPU WGL kernel, all keys in one vmapped launch.
+
+Prints ONE JSON line:
+  metric       what was measured
+  value        ops/sec checked (history length / wall time to verdict)
+  unit         ops/s
+  vs_baseline  speedup vs the baseline target of 60 s for the same
+               history (BASELINE.md: "checked < 60 s on TPU, verdict
+               identical to knossos") — i.e. 60 / elapsed_seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _tpu_usable(timeout: float = 45.0) -> bool:
+    """Probe TPU/axon backend availability in a SUBPROCESS — if the
+    tunnel is down, backend init hangs rather than failing, so the probe
+    must be killable."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            capture_output=True,
+            timeout=timeout,
+            text=True,
+        )
+        return p.returncode == 0 and "ok" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def build_history(n_keys=34, ops_per_key=300, clients_per_key=5, seed=0):
+    """Synthesize the benchmark workload: per-key concurrent histories
+    from a simulated linearizable register (the checking cost is what's
+    benchmarked; generation is host-side either way)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from helpers import random_register_history
+
+    from jepsen_tpu.history import entries as make_entries
+
+    per_key = []
+    total_ops = 0
+    for k in range(n_keys):
+        hist = random_register_history(
+            n_process=clients_per_key,
+            n_ops=ops_per_key // 2,  # n_ops counts invocations; 2 events each
+            seed=seed + k,
+        )
+        total_ops += len(hist)
+        per_key.append(make_entries(hist))
+    return per_key, total_ops
+
+
+def main():
+    use_tpu = _tpu_usable()
+    if not use_tpu:
+        # TPU tunnel unavailable: fall back to CPU so the bench still
+        # reports (value reflects CPU, vs_baseline still comparable)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+
+    if not use_tpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.ops import wgl_tpu
+
+    per_key, total_ops = build_history()
+    model = CASRegister()
+
+    # warm-up with the IDENTICAL batch shape + sharding so the timed run
+    # measures pure search, not XLA compilation (a different lane count
+    # would retrace)
+    wgl_tpu.analysis_batch(model, per_key)
+
+    t0 = time.monotonic()
+    results = wgl_tpu.analysis_batch(model, per_key)
+    elapsed = time.monotonic() - t0
+
+    assert all(r.valid is True for r in results), [r.valid for r in results]
+
+    value = total_ops / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "cas-register 10k-op history linearizability "
+                "check (34 keys, 5 clients/key, WGL kernel, "
+                + ("tpu" if use_tpu else "cpu-fallback")
+                + ")",
+                "value": round(value, 1),
+                "unit": "ops/s",
+                "vs_baseline": round(60.0 / elapsed, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -16,8 +16,24 @@ set "can take *hours*" to write, checker.clj:138-141).
 
 from __future__ import annotations
 
+import atexit
 import threading
 from typing import Any
+
+# Threads abandoned by competition races (the losing search keeps
+# running). They must be joined before interpreter exit: a daemon thread
+# killed mid-XLA-compile aborts the process with "FATAL: exception not
+# rethrown".
+_abandoned_racers: list = []
+
+
+@atexit.register
+def _drain_racers():
+    import time as _t
+
+    deadline = _t.monotonic() + 120  # one shared bound, however many races
+    for t in _abandoned_racers:
+        t.join(timeout=max(0.0, deadline - _t.monotonic()))
 
 from ..history import entries as make_entries
 from ..models import Model
@@ -34,16 +50,18 @@ def _tpu_eligible(model, es) -> bool:
         from ..ops import wgl_tpu  # noqa: F401
     except ImportError:
         return False
-    if mjit.for_model(model) is None:
+    jm = mjit.for_model(model)
+    if jm is None:
         return False
     try:
-        for v_in, v_out in zip(es.value_in, es.value_out):
-            for v in (v_in, v_out):
-                if isinstance(v, (tuple, list)):
-                    for x in v:
-                        mjit.encode_value(x)
-                else:
-                    mjit.encode_value(v)
+        for f, v in zip(es.f, es.value_out):
+            if f not in jm.fs:
+                continue  # encoded as never-linearizable, value unused
+            if isinstance(v, (tuple, list)):
+                for x in v:
+                    mjit.encode_value(x)
+            else:
+                mjit.encode_value(v)
     except (OverflowError, TypeError, ValueError):
         return False
     return True
@@ -126,6 +144,9 @@ class Linearizable(Checker):
         for t in threads:
             t.start()
         done.wait()
+        for t in threads:
+            if t.is_alive():
+                _abandoned_racers.append(t)
         with lock:
             for r in results.values():
                 if r.valid != "unknown":

@@ -46,7 +46,8 @@ class JitModel:
 
 
 def _cas_register_step(state, f, v1, v2):
-    # f: 0=read 1=write 2=cas  (REGISTER_SCHEMA order)
+    # f: 0=read 1=write 2=cas (REGISTER_SCHEMA order); f == -1
+    # (unknown/malformed op) falls through every branch to ok=False
     is_read = f == 0
     is_write = f == 1
     is_cas = f == 2
@@ -71,9 +72,10 @@ cas_register = JitModel(
 
 
 def _register_step(state, f, v1, v2):
-    # f: 0=read 1=write
+    # f: 0=read 1=write; f == -1 (unknown/malformed op) is never ok
+    is_read = f == 0
     is_write = f == 1
-    ok = jnp.where(is_write, True, (v1 == NIL32) | (state == v1))
+    ok = jnp.where(is_write, True, is_read & ((v1 == NIL32) | (state == v1)))
     new_state = jnp.where(is_write, v1, state)
     return new_state, ok
 
@@ -87,9 +89,10 @@ register = JitModel(
 
 
 def _mutex_step(state, f, v1, v2):
-    # f: 0=acquire 1=release; state: 0=free 1=held
+    # f: 0=acquire 1=release; state: 0=free 1=held; f == -1 never ok
     is_acquire = f == 0
-    ok = jnp.where(is_acquire, state == 0, state == 1)
+    is_release = f == 1
+    ok = jnp.where(is_acquire, state == 0, is_release & (state == 1))
     new_state = jnp.where(ok, jnp.where(is_acquire, 1, 0), state)
     return new_state, ok
 
@@ -121,9 +124,17 @@ def for_model(model) -> JitModel | None:
 
 
 def encode_value(v) -> int:
-    """Encode one payload scalar for the kernel; None -> NIL32."""
+    """Encode one payload scalar for the kernel; None -> NIL32. Only true
+    integers are encodable — floats/strings would be silently truncated
+    or coerced, letting the kernel accept histories the host model
+    rejects, so they raise instead (the checker then uses the host
+    search)."""
     if v is None:
         return int(NIL32)
+    import numbers
+
+    if not isinstance(v, numbers.Integral):
+        raise TypeError(f"value {v!r} has no int32 kernel encoding")
     v = int(v)
     if not (-(2**30) < v < 2**30):
         raise OverflowError(f"value {v} does not fit the int32 kernel encoding")

@@ -1,0 +1,448 @@
+"""Wing-Gong-Lowe linearizability search as a jitted TPU kernel.
+
+The north star (BASELINE.json): knossos.wgl re-expressed as a bitmask-DFS
+with the model's state-transition function compiled into the kernel and
+the memoization cache in HBM. The host algorithm (ops/wgl_host.py) is
+restated in fixed-shape, branch-free form:
+
+- the doubly-linked event list is a pair of int32 arrays (nxt/prv)
+  updated functionally with scatter;
+- the DFS is ONE lax.while_loop whose body executes exactly one search
+  step (try-linearize / advance / backtrack), selected with jnp.where —
+  no data-dependent Python control flow (XLA traces it once);
+- the linearized set is a uint32[W] bitset;
+- the memo cache is an open-addressed hash table storing the FULL
+  (bitset, state) key — lookups compare every word, so pruning is exact
+  and the verdict is bit-identical to the host search; a full table only
+  loses pruning, never soundness;
+- the undo stack is an explicit int32 stack (entry id, previous state).
+
+Scale-out: `analysis_batch` vmaps the whole search over independent keys
+(jepsen.independent's sharding axis, independent.clj:66-220) — every
+lane advances one search step per iteration in lockstep, which is
+exactly the shape TPUs like. Sharding the lane axis over a
+jax.sharding.Mesh spreads keys across devices; all per-lane work is
+elementwise, so no collectives are needed inside the loop.
+
+Single-lane latency is dominated by sequential dependency (one step per
+iteration), so checking ONE history on TPU is no faster than the host;
+the win is checking tens-to-hundreds of keys concurrently. The
+linearizable checker's "auto"/"competition" modes exploit exactly that
+split.
+"""
+
+from __future__ import annotations
+
+import math
+import time as _time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..history import Entries
+from ..models import jit as mjit
+from .wgl_host import WGLResult, analysis as wgl_host_analysis
+
+# verdict codes
+RUNNING, VALID, INVALID, UNKNOWN = 0, 1, 2, 3
+
+DEFAULT_MAX_STEPS = 2_000_000
+DEFAULT_CACHE_BITS = 13  # 8192 slots per lane
+N_PROBES = 8
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(1, math.ceil(math.log2(max(2, x))))
+
+
+def encode_entries(es: Entries, jm: mjit.JitModel, n_pad: int) -> dict:
+    """Pack host Entries into fixed-shape int32 arrays for one kernel
+    lane. Event node ids: 0 is the head sentinel; event at position p is
+    node p+1. Padded entries simply never appear in the linked list."""
+    n = len(es)
+    assert n <= n_pad
+    m = 2 * n_pad + 1
+    f = np.zeros(n_pad, np.int32)
+    v1 = np.full(n_pad, mjit.NIL32, np.int32)
+    v2 = np.full(n_pad, mjit.NIL32, np.int32)
+    crashed = np.zeros(n_pad, bool)
+    call_node = np.zeros(n_pad, np.int32)
+    ret_node = np.zeros(n_pad, np.int32)
+    node_entry = np.zeros(m, np.int32)
+    node_is_call = np.zeros(m, bool)
+    for e in range(n):
+        val = es.value_out[e]
+        fname = es.f[e]
+        # Ops the host model can NEVER linearize (unknown :f, or a cas
+        # with unknown arguments -> Inconsistent) encode as f = -1: every
+        # JitModel step maps -1 to ok=False, the exact kernel image of
+        # Inconsistent.
+        if fname not in jm.fs or (fname == "cas" and val is None):
+            f[e] = -1
+        else:
+            f[e] = jm.f_code(fname)
+            if isinstance(val, (tuple, list)):
+                v1[e] = mjit.encode_value(val[0] if len(val) > 0 else None)
+                v2[e] = mjit.encode_value(val[1] if len(val) > 1 else None)
+            else:
+                v1[e] = mjit.encode_value(val)
+        crashed[e] = bool(es.crashed[e])
+        c = int(es.call_pos[e]) + 1
+        r = int(es.ret_pos[e]) + 1
+        call_node[e] = c
+        ret_node[e] = r
+        node_entry[c] = e
+        node_entry[r] = e
+        node_is_call[c] = True
+    # initial linked list: nodes 1..2n in order, tail -> 0
+    nxt = np.zeros(m, np.int32)
+    prv = np.zeros(m, np.int32)
+    for p in range(2 * n):
+        nxt[p] = p + 1
+    if n > 0:
+        nxt[2 * n] = 0
+        for p in range(1, 2 * n + 1):
+            prv[p] = p - 1
+    return {
+        "f": f,
+        "v1": v1,
+        "v2": v2,
+        "crashed": crashed,
+        "call_node": call_node,
+        "ret_node": ret_node,
+        "node_entry": node_entry,
+        "node_is_call": node_is_call,
+        "nxt0": nxt,
+        "prv0": prv,
+        "n": np.int32(n),
+        "n_completed": np.int32(es.n_completed),
+    }
+
+
+def _hash_key(lin: jnp.ndarray, state) -> jnp.ndarray:
+    """FNV-ish fold of the bitset words and state into a uint32."""
+    h = jnp.uint32(2166136261)
+    for w in range(lin.shape[0]):
+        h = (h ^ lin[w]) * jnp.uint32(16777619)
+    h = (h ^ state.astype(jnp.uint32)) * jnp.uint32(16777619)
+    h = h ^ (h >> 15)
+    return h
+
+
+def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
+                max_steps: int):
+    """The complete DFS for one lane. All shapes static."""
+    n_pad = ent["f"].shape[0]
+    cache_size = 1 << cache_bits
+    mask = jnp.uint32(cache_size - 1)
+    key_width = n_words + 1  # bitset words + state
+
+    # cache: keys[cache_size, key_width], used[cache_size]
+    cache_keys = jnp.zeros((cache_size, key_width), jnp.int32)
+    cache_used = jnp.zeros(cache_size, bool)
+
+    init = dict(
+        nxt=ent["nxt0"].astype(jnp.int32),
+        prv=ent["prv0"].astype(jnp.int32),
+        node=ent["nxt0"][0].astype(jnp.int32),
+        state=jnp.int32(step_fn.init_state),
+        linearized=jnp.zeros(n_words, jnp.uint32),
+        depth=jnp.int32(0),
+        stack_e=jnp.zeros(n_pad, jnp.int32),
+        stack_s=jnp.zeros(n_pad, jnp.int32),
+        completed_done=jnp.int32(0),
+        cache_keys=cache_keys,
+        cache_used=cache_used,
+        steps=jnp.int32(0),
+        verdict=jnp.where(
+            ent["n_completed"] == 0, jnp.int32(VALID), jnp.int32(RUNNING)
+        ),
+    )
+
+    f_arr = ent["f"]
+    v1_arr = ent["v1"]
+    v2_arr = ent["v2"]
+    crashed_arr = ent["crashed"]
+    call_node_arr = ent["call_node"]
+    ret_node_arr = ent["ret_node"]
+    node_entry_arr = ent["node_entry"]
+    node_is_call_arr = ent["node_is_call"]
+    n_completed = ent["n_completed"]
+
+    def cond(st):
+        return (st["verdict"] == RUNNING) & (st["steps"] < max_steps)
+
+    def body(st):
+        nxt, prv = st["nxt"], st["prv"]
+        node = st["node"]
+        state = st["state"]
+        lin = st["linearized"]
+        depth = st["depth"]
+
+        e = node_entry_arr[node]
+        is_call = (node != 0) & node_is_call_arr[node]
+
+        new_state, ok = step_fn.step(state, f_arr[e], v1_arr[e], v2_arr[e])
+        new_state = new_state.astype(jnp.int32)
+        can_lin = is_call & ok
+
+        word = e // 32
+        bit = (jnp.uint32(1) << (e % 32).astype(jnp.uint32))
+        new_lin = lin.at[word].set(lin[word] | bit)
+
+        # ---- cache probe (exact full-key compare) ----
+        key = jnp.concatenate(
+            [new_lin.astype(jnp.int32), new_state[None]]
+        )
+        h = _hash_key(new_lin, new_state)
+        probe_idx = (h[None] + jnp.arange(N_PROBES, dtype=jnp.uint32)) & mask
+        probe_idx = probe_idx.astype(jnp.int32)
+        slot_keys = st["cache_keys"][probe_idx]          # [P, key_width]
+        slot_used = st["cache_used"][probe_idx]          # [P]
+        matches = slot_used & jnp.all(slot_keys == key[None, :], axis=1)
+        found = jnp.any(matches)
+        free = ~slot_used
+        has_free = jnp.any(free)
+        first_free = jnp.argmax(free)
+        # insert slot: first free probe, else overwrite last probe (only
+        # loses pruning, never soundness)
+        ins = jnp.where(has_free, probe_idx[first_free], probe_idx[-1])
+
+        do_lift = can_lin & ~found
+        # ---- branch: lift ----
+        cn = call_node_arr[e]
+        rn = ret_node_arr[e]
+        # unlink call node then ret node (order immaterial for scatter
+        # since cn<rn positions are distinct and pointers are per-node)
+        l_nxt = nxt
+        l_prv = prv
+        # unlink cn
+        l_nxt = l_nxt.at[l_prv[cn]].set(l_nxt[cn])
+        l_prv = l_prv.at[l_nxt[cn]].set(l_prv[cn])
+        # unlink rn (pointers of rn still valid)
+        l_nxt = l_nxt.at[l_prv[rn]].set(l_nxt[rn])
+        l_prv = l_prv.at[l_nxt[rn]].set(l_prv[rn])
+
+        lift_stack_e = st["stack_e"].at[depth].set(e)
+        lift_stack_s = st["stack_s"].at[depth].set(state)
+        lift_completed = st["completed_done"] + jnp.where(
+            crashed_arr[e], 0, 1
+        ).astype(jnp.int32)
+        lift_cache_keys = st["cache_keys"].at[ins].set(key)
+        lift_cache_used = st["cache_used"].at[ins].set(True)
+
+        # ---- branch: backtrack (hit a return node / END) ----
+        can_pop = depth > 0
+        e2 = st["stack_e"][depth - 1]
+        pop_state = st["stack_s"][depth - 1]
+        cn2 = call_node_arr[e2]
+        rn2 = ret_node_arr[e2]
+        # relink rn2 then cn2 (reverse of lift order)
+        b_nxt = nxt
+        b_prv = prv
+        b_nxt = b_nxt.at[b_prv[rn2]].set(rn2)
+        b_prv = b_prv.at[b_nxt[rn2]].set(rn2)
+        b_nxt = b_nxt.at[b_prv[cn2]].set(cn2)
+        b_prv = b_prv.at[b_nxt[cn2]].set(cn2)
+        word2 = e2 // 32
+        bit2 = (jnp.uint32(1) << (e2 % 32).astype(jnp.uint32))
+        pop_lin = lin.at[word2].set(lin[word2] & ~bit2)
+        pop_completed = st["completed_done"] - jnp.where(
+            crashed_arr[e2], 0, 1
+        ).astype(jnp.int32)
+
+        # ---- select ----
+        advance = is_call & ~do_lift  # consistent-but-seen or inconsistent
+        backtrack = ~is_call
+
+        sel = lambda on_lift, on_adv, on_back: jnp.where(  # noqa: E731
+            do_lift, on_lift, jnp.where(advance, on_adv, on_back)
+        )
+        sel_arr = lambda on_lift, on_adv, on_back: jnp.where(  # noqa: E731
+            do_lift,
+            on_lift,
+            jnp.where(advance, on_adv, jnp.where(can_pop, on_back, on_adv)),
+        )
+
+        nxt_out = sel_arr(l_nxt, nxt, b_nxt)
+        prv_out = sel_arr(l_prv, prv, b_prv)
+        node_out = sel(
+            l_nxt[0],
+            nxt[node],
+            jnp.where(can_pop, b_nxt[cn2], node),
+        )
+        state_out = sel(new_state, state, jnp.where(can_pop, pop_state, state))
+        lin_out = jnp.where(
+            do_lift,
+            new_lin,
+            jnp.where(backtrack & can_pop, pop_lin, lin),
+        )
+        depth_out = sel(depth + 1, depth, jnp.where(can_pop, depth - 1, depth))
+        completed_out = sel(
+            lift_completed,
+            st["completed_done"],
+            jnp.where(can_pop, pop_completed, st["completed_done"]),
+        )
+        stack_e_out = jnp.where(do_lift, lift_stack_e, st["stack_e"])
+        stack_s_out = jnp.where(do_lift, lift_stack_s, st["stack_s"])
+        cache_keys_out = jnp.where(do_lift, lift_cache_keys, st["cache_keys"])
+        cache_used_out = jnp.where(do_lift, lift_cache_used, st["cache_used"])
+
+        verdict = jnp.where(
+            do_lift & (lift_completed == n_completed),
+            jnp.int32(VALID),
+            jnp.where(
+                backtrack & ~can_pop, jnp.int32(INVALID), jnp.int32(RUNNING)
+            ),
+        )
+
+        return dict(
+            nxt=nxt_out,
+            prv=prv_out,
+            node=node_out,
+            state=state_out,
+            linearized=lin_out,
+            depth=depth_out,
+            stack_e=stack_e_out,
+            stack_s=stack_s_out,
+            completed_done=completed_out,
+            cache_keys=cache_keys_out,
+            cache_used=cache_used_out,
+            steps=st["steps"] + 1,
+            verdict=verdict,
+        )
+
+    out = lax.while_loop(cond, body, init)
+    final_verdict = jnp.where(
+        out["verdict"] == RUNNING, jnp.int32(UNKNOWN), out["verdict"]
+    )
+    return final_verdict, out["steps"], out["depth"]
+
+
+def build_kernel(jm: mjit.JitModel, n_pad: int, cache_bits: int = DEFAULT_CACHE_BITS,
+                 max_steps: int = DEFAULT_MAX_STEPS):
+    """A jitted batch kernel for histories padded to n_pad entries:
+    dict of stacked arrays -> (verdicts, steps, depths), vmapped over the
+    leading lane axis."""
+    n_words = max(1, (n_pad + 31) // 32)
+
+    def one(ent):
+        return _search_one(ent, jm, n_words, cache_bits, max_steps)
+
+    return jax.jit(jax.vmap(one))
+
+
+_kernel_cache: dict = {}
+
+
+def _kernel_for(jm: mjit.JitModel, n_pad: int, cache_bits: int, max_steps: int):
+    key = (jm.name, n_pad, cache_bits, max_steps)
+    if key not in _kernel_cache:
+        _kernel_cache[key] = build_kernel(jm, n_pad, cache_bits, max_steps)
+    return _kernel_cache[key]
+
+
+def _pad_size(n: int) -> int:
+    """Bucket entry counts to limit kernel recompiles (variable-length
+    subhistories -> a few static shapes; SURVEY.md SS7.4)."""
+    return max(32, _next_pow2(n))
+
+
+def _stack(ents: list[dict]) -> dict:
+    return {
+        k: jnp.asarray(np.stack([e[k] for e in ents]))
+        for k in ents[0]
+    }
+
+
+def analysis_batch(
+    model,
+    entries_list: list[Entries],
+    cache_bits: int = DEFAULT_CACHE_BITS,
+    max_steps: int = DEFAULT_MAX_STEPS,
+    devices=None,
+) -> list[WGLResult]:
+    """Check many independent histories in one vmapped kernel launch.
+    With `devices` (or more than one addressable device and enough
+    lanes), lanes are sharded across a 1-D mesh."""
+    jm = mjit.for_model(model)
+    if jm is None:
+        raise ValueError(f"model {model!r} has no int32 kernel encoding")
+    if not entries_list:
+        return []
+    n_pad = _pad_size(max(len(es) for es in entries_list))
+    ents = [encode_entries(es, jm, n_pad) for es in entries_list]
+    n_lanes = len(ents)
+    batch = _stack(ents)
+
+    devices = devices if devices is not None else jax.devices()
+    n_dev = len(devices)
+    pad_lanes = 0
+    if n_dev > 1 and n_lanes >= n_dev:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        pad_lanes = (-n_lanes) % n_dev
+        if pad_lanes:
+            batch = {
+                k: jnp.concatenate(
+                    [v, jnp.repeat(v[-1:], pad_lanes, axis=0)], axis=0
+                )
+                for k, v in batch.items()
+            }
+        mesh = Mesh(np.array(devices), ("keys",))
+        sharding = NamedSharding(mesh, P("keys"))
+        batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+    kernel = _kernel_for(jm, n_pad, cache_bits, max_steps)
+    verdicts, steps, _depths = jax.block_until_ready(kernel(batch))
+    verdicts = np.asarray(verdicts)[:n_lanes]
+    steps = np.asarray(steps)[:n_lanes]
+
+    out = []
+    for i, es in enumerate(entries_list):
+        v = int(verdicts[i])
+        valid = {VALID: True, INVALID: False, UNKNOWN: "unknown"}[v]
+        r = WGLResult(valid=valid, steps=int(steps[i]))
+        if valid is False:
+            # Recover counterexample details on host (only failed keys
+            # pay this cost; verdicts agree by construction)
+            r = wgl_host_analysis(model, es)
+        out.append(r)
+    return out
+
+
+# Conservative lower bound on kernel search steps per second, used to
+# translate a wall-clock budget into a step budget. Underestimating only
+# makes the kernel give up (unknown) EARLIER than the wall budget —
+# never later by more than one kernel launch.
+STEPS_PER_SEC_ESTIMATE = 50_000
+
+
+def analysis(
+    model,
+    history,
+    time_limit: float | None = None,
+    max_steps: int = DEFAULT_MAX_STEPS,
+    cache_bits: int = DEFAULT_CACHE_BITS,
+) -> WGLResult:
+    """Single-history TPU check (the jepsen.checker/linearizable
+    backend). A time_limit is translated into a step budget using a
+    conservative steps/sec estimate (a while_loop cannot consult the
+    wall clock mid-flight on device)."""
+    from ..history import entries as make_entries
+
+    es = history if isinstance(history, Entries) else make_entries(history)
+    if es.n_completed == 0:
+        return WGLResult(valid=True)
+    if time_limit is not None:
+        max_steps = min(
+            max_steps, max(1000, int(time_limit * STEPS_PER_SEC_ESTIMATE))
+        )
+    (r,) = analysis_batch(
+        model, [es], cache_bits=cache_bits, max_steps=max_steps
+    )
+    return r
