@@ -332,8 +332,9 @@ def snarf_logs(test) -> None:
 
     def snarf(node):
         for path in dbo.log_files(test, node):
-            dest = store.path(test, [str(node), path.lstrip("/").replace("/", "_")])
-            dest.parent.mkdir(parents=True, exist_ok=True)
+            dest = store.path_(
+                test, [str(node), path.lstrip("/").replace("/", "_")]
+            )
             try:
                 test["remote"].download(node, path, dest)
             except Exception:  # noqa: BLE001
@@ -397,7 +398,7 @@ def run(test: dict) -> dict:
                     db_mod.cycle(test)
                 try:
                     with with_relative_time():
-                        test["history"] = run_case(test)
+                        test["history"] = index(run_case(test))
                     log.info("Run complete, writing")
                     if store is not None and test.get("name"):
                         store.save_1(test)

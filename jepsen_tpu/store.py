@@ -1,0 +1,419 @@
+"""Persistent storage for test runs and later analysis (reference:
+jepsen.store, store.clj).
+
+Layout parity with the reference (store.clj:125-154, 302-328):
+
+    store/<test-name>/<start-time>/
+        jepsen.log       engine log for the run          (store.clj:398-418)
+        history.txt      human-readable op log           (store.clj:340-357)
+        history.jsonl    one JSON op per line (the EDN history analog)
+        history.npz      TensorHistory — the TPU-native flat encoding;
+                         this replaces test.fressian as the machine
+                         snapshot (SURVEY.md SS7.1: one flat format for
+                         store, checker input, and wire)
+        test.json        serializable test-map snapshot  (store.clj:167-175)
+        results.json     analysis results                (store.clj:336-339)
+    store/current        symlink -> the running test     (store.clj:302-328)
+    store/latest         symlink -> the newest saved test
+    store/<name>/latest  symlink -> the newest run of that test
+
+Unlike the reference there is no opaque binary snapshot (fressian,
+store.clj:28-123): every artifact is JSON, text, or the npz tensor, all
+reloadable without the defining code. `load()` + `jepsen_tpu.cli`'s
+`analyze` subcommand re-check a stored history with fresh checkers and no
+cluster (cli.clj:366-397 semantics).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import shutil
+from typing import Any, Iterable
+
+from .history import Op, TensorHistory
+
+BASE_DIR = "store"
+
+log = logging.getLogger("jepsen_tpu.store")
+
+#: test-map keys that hold live objects and never serialize
+#: (store.clj:167-172), plus engine internals.
+DEFAULT_NONSERIALIZABLE_KEYS = {
+    "db",
+    "os",
+    "net",
+    "client",
+    "checker",
+    "nemesis",
+    "generator",
+    "model",
+    "remote",
+    "ssh",
+    "barrier",
+    "active_histories",
+    "schema",
+}
+
+
+def nonserializable_keys(test) -> set:
+    """Default nonserializable keys plus the test's own
+    (store.clj:174-179), plus every "_"-prefixed engine-internal key."""
+    ks = set(DEFAULT_NONSERIALIZABLE_KEYS)
+    ks.update(test.get("nonserializable_keys", ()))
+    ks.update(k for k in test if isinstance(k, str) and k.startswith("_"))
+    return ks
+
+
+def time_str(t) -> str:
+    """Render a start-time as a directory name (the reference's
+    :basic-date-time local format, store.clj:131-141)."""
+    if isinstance(t, str):
+        return t
+    if isinstance(t, datetime.datetime):
+        return t.strftime("%Y%m%dT%H%M%S.%f")[:-3]
+    raise TypeError(f"can't render start_time {t!r}")
+
+
+def base_dir(test=None) -> str:
+    """The store root; override per-test with :store_dir."""
+    if test is not None and test.get("store_dir"):
+        return str(test["store_dir"])
+    return BASE_DIR
+
+
+def _flatten(args) -> list:
+    out = []
+    for a in args:
+        if a is None:
+            continue
+        if isinstance(a, (list, tuple)):
+            out.extend(_flatten(a))
+        else:
+            out.append(str(a))
+    return out
+
+
+def path(test, *args) -> str:
+    """The directory for a test's results; extra args name a file inside
+    it. Nested lists flatten; None components are ignored
+    (store.clj:125-147)."""
+    assert test.get("name"), "test needs a :name to have a store path"
+    assert test.get("start_time"), "test needs a :start_time"
+    d = os.path.join(
+        base_dir(test), str(test["name"]), time_str(test["start_time"])
+    )
+    return os.path.join(d, *_flatten(args)) if args else d
+
+
+def path_(test, *args) -> str:
+    """path(), but ensures the containing directory exists
+    (store.clj:149-154)."""
+    p = path(test, *args)
+    os.makedirs(os.path.dirname(p) if args else p, exist_ok=True)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Writers
+
+def _json_keys(v):
+    """json's default= hook never applies to dict KEYS — independent-
+    checker results are keyed by arbitrary workload keys (e.g. tuples),
+    so stringify any non-primitive key up front."""
+    if isinstance(v, dict):
+        return {
+            k if isinstance(k, (str, int, float, bool)) or k is None else str(k):
+            _json_keys(x)
+            for k, x in v.items()
+        }
+    if isinstance(v, (list, tuple)):
+        return [_json_keys(x) for x in v]
+    return v
+
+
+def _json_default(o):
+    if isinstance(o, datetime.datetime):
+        return o.isoformat()
+    if isinstance(o, Op):
+        return o.to_dict()
+    if isinstance(o, (set, frozenset)):
+        return sorted(o, key=repr)
+    if isinstance(o, bytes):
+        return o.decode("utf-8", "replace")
+    if hasattr(o, "item"):  # numpy scalars
+        return o.item()
+    if hasattr(o, "tolist"):  # numpy arrays
+        return o.tolist()
+    return repr(o)
+
+
+def write_json(test, subpath, value) -> str:
+    """Write any value as pretty JSON under the test dir."""
+    p = path_(test, subpath)
+    with open(p, "w") as f:
+        json.dump(_json_keys(value), f, indent=1, default=_json_default)
+        f.write("\n")
+    return p
+
+
+# independent.py historically calls this write_edn (the reference writes
+# results.edn); the on-disk format here is JSON.
+write_edn = write_json
+
+
+def write_history_txt(test, subpath, history: Iterable[Op]) -> str:
+    """history.txt: one tab-separated line per op (util/pwrite-history!
+    format, util.clj:184-206)."""
+    p = path_(test, subpath)
+    with open(p, "w") as f:
+        for o in history:
+            f.write(str(o))
+            f.write("\n")
+    return p
+
+
+def write_history(test) -> None:
+    """Write history.txt + history.jsonl (+ history.npz when the test
+    carries a tensor schema) — store.clj:340-357."""
+    hist = test.get("history") or []
+    write_history_txt(test, "history.txt", hist)
+    p = path_(test, "history.jsonl")
+    with open(p, "w") as f:
+        for o in hist:
+            f.write(json.dumps(o.to_dict(), default=_json_default))
+            f.write("\n")
+    schema = test.get("schema")
+    if schema is not None:
+        try:
+            TensorHistory.encode(hist, schema).save(path_(test, "history.npz"))
+        except Exception:  # noqa: BLE001 — tensor snapshot is best-effort
+            log.warning("couldn't write history.npz", exc_info=True)
+
+
+def write_test(test) -> str:
+    """test.json: the serializable slice of the test map (the fressian
+    snapshot analog, store.clj:359-366)."""
+    drop = nonserializable_keys(test)
+    snap = {k: v for k, v in test.items() if k not in drop and k != "history"}
+    snap["start_time"] = time_str(test["start_time"])
+    return write_json(test, "test.json", snap)
+
+
+def write_results(test) -> str:
+    """results.json (store.clj:336-339)."""
+    return write_json(test, "results.json", test.get("results"))
+
+
+# ---------------------------------------------------------------------------
+# Symlinks
+
+def update_symlink(test, dest_parts: list) -> None:
+    """Symlink base_dir/<dest_parts...> -> the test dir, replacing any
+    existing link (store.clj:302-313)."""
+    src = path(test)
+    if not os.path.exists(src):
+        return
+    dest = os.path.join(base_dir(test), *dest_parts)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    try:
+        if os.path.islink(dest) or os.path.exists(dest):
+            os.remove(dest)
+        os.symlink(os.path.relpath(src, os.path.dirname(dest)), dest)
+    except OSError:
+        log.warning("couldn't update symlink %s", dest, exc_info=True)
+
+
+def update_current_symlink(test) -> None:
+    update_symlink(test, ["current"])
+
+
+def update_symlinks(test) -> None:
+    """current, latest, and <name>/latest (store.clj:315-328)."""
+    for dest in (["current"], ["latest"], [str(test["name"]), "latest"]):
+        update_symlink(test, dest)
+
+
+# ---------------------------------------------------------------------------
+# Save phases (core.clj:636 calls save_1 post-run; analyze! calls save_2)
+
+def save_1(test) -> dict:
+    """Phase 1, after the run: history + test snapshot + symlinks
+    (store.clj:367-379)."""
+    write_history(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+def save_2(test) -> dict:
+    """Phase 2, after analysis: results + refreshed test snapshot.
+    Unlike the reference (store.clj:381-392), the history is NOT
+    rewritten — analysis only adds :index fields, which write_history
+    already derives, and rewriting a 10k+-op history twice per run is
+    wasted I/O."""
+    write_results(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+# ---------------------------------------------------------------------------
+# Loading
+
+def tests(name=None, store_dir=None) -> dict:
+    """With no name: {test-name: {time-str: dir}}. With a name:
+    {time-str: dir} (store.clj:241-266)."""
+    root = store_dir or BASE_DIR
+    if name is None:
+        out = {}
+        if os.path.isdir(root):
+            for n in sorted(os.listdir(root)):
+                if n in ("latest", "current"):
+                    continue
+                if os.path.isdir(os.path.join(root, n)):
+                    out[n] = tests(n, store_dir=root)
+        return out
+    d = os.path.join(root, str(name))
+    out = {}
+    if os.path.isdir(d):
+        for t in sorted(os.listdir(d)):
+            full = os.path.join(d, t)
+            if t != "latest" and os.path.isdir(full):
+                out[t] = full
+    return out
+
+
+def load_history(test) -> list[Op]:
+    """Reload a run's history, preferring the jsonl form."""
+    p = path(test, "history.jsonl")
+    if os.path.exists(p):
+        with open(p) as f:
+            return [Op.from_dict(json.loads(line)) for line in f if line.strip()]
+    p = path(test, "history.npz")
+    if os.path.exists(p):
+        return TensorHistory.load(p).decode()
+    raise FileNotFoundError(f"no stored history under {path(test)}")
+
+
+def load(name, time_s, store_dir=None) -> dict:
+    """Load a stored test by name and time: the test.json snapshot with
+    its history attached (store.clj:177-184)."""
+    test = {"name": name, "start_time": time_s}
+    if store_dir:
+        test["store_dir"] = store_dir
+    p = path(test, "test.json")
+    if os.path.exists(p):
+        with open(p) as f:
+            snap = json.load(f)
+        snap.pop("store_dir", None)
+        test.update(snap)
+        test["name"], test["start_time"] = name, time_s
+        if store_dir:
+            test["store_dir"] = store_dir
+    test["history"] = load_history(test)
+    return test
+
+
+def load_results(name, time_s, store_dir=None) -> Any:
+    """Load only results.json (store.clj:224-233)."""
+    test = {"name": name, "start_time": time_s}
+    if store_dir:
+        test["store_dir"] = store_dir
+    with open(path(test, "results.json")) as f:
+        return json.load(f)
+
+
+def _resolve_latest(store_dir=None):
+    root = store_dir or BASE_DIR
+    link = os.path.join(root, "latest")
+    # Trust the symlink only while it resolves — delete() can leave it
+    # dangling; fall back to scanning.
+    if os.path.islink(link) and os.path.isdir(os.path.realpath(link)):
+        target = os.path.realpath(link)
+        time_s = os.path.basename(target)
+        name = os.path.basename(os.path.dirname(target))
+        return name, time_s
+    newest = None
+    for name, runs in tests(store_dir=root).items():
+        for t in runs:
+            if newest is None or t > newest[1]:
+                newest = (name, t)
+    return newest
+
+
+def latest(store_dir=None) -> dict | None:
+    """Load the most recent test (store.clj:291-300)."""
+    found = _resolve_latest(store_dir)
+    if found is None:
+        return None
+    return load(found[0], found[1], store_dir=store_dir)
+
+
+def delete(name=None, time_s=None, store_dir=None) -> None:
+    """Delete all tests / all runs of a test / one run
+    (store.clj:420-437)."""
+    root = store_dir or BASE_DIR
+    if name is None:
+        for n in list(tests(store_dir=root)):
+            delete(n, store_dir=root)
+    elif time_s is None:
+        d = os.path.join(root, str(name))
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    else:
+        d = os.path.join(root, str(name), time_s)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    _prune_dangling_symlinks(root)
+
+
+def _prune_dangling_symlinks(root) -> None:
+    """Drop latest/current links left dangling by delete()."""
+    candidates = [os.path.join(root, "latest"), os.path.join(root, "current")]
+    if os.path.isdir(root):
+        candidates += [
+            os.path.join(root, n, "latest")
+            for n in os.listdir(root)
+            if os.path.isdir(os.path.join(root, n))
+        ]
+    for link in candidates:
+        if os.path.islink(link) and not os.path.isdir(os.path.realpath(link)):
+            try:
+                os.remove(link)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Logging (store.clj:394-418): a file handler on the framework's root
+# logger for the duration of the run.
+
+_LOG_FORMAT = "%(asctime)s\t%(levelname)s\t[%(threadName)s] %(name)s: %(message)s"
+
+
+def start_logging(test) -> None:
+    if not (test.get("name") and test.get("start_time")):
+        return
+    handler = logging.FileHandler(path_(test, "jepsen.log"))
+    handler.setFormatter(logging.Formatter(_LOG_FORMAT))
+    root = logging.getLogger("jepsen_tpu")
+    test["_log_prev_level"] = root.level
+    if root.getEffectiveLevel() > logging.INFO:
+        root.setLevel(logging.INFO)
+    root.addHandler(handler)
+    test["_log_handler"] = handler
+    update_current_symlink(test)
+
+
+def stop_logging(test) -> None:
+    handler = test.pop("_log_handler", None)
+    if handler is not None:
+        root = logging.getLogger("jepsen_tpu")
+        root.removeHandler(handler)
+        handler.close()
+        prev = test.pop("_log_prev_level", None)
+        if prev is not None:
+            root.setLevel(prev)

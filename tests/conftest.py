@@ -19,3 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _store_tmpdir(tmp_path, monkeypatch):
+    """Redirect the store root into the test's tmp dir so engine runs
+    never write a store/ directory into the repo."""
+    from jepsen_tpu import store
+
+    monkeypatch.setattr(store, "BASE_DIR", str(tmp_path / "store"))

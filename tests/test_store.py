@@ -1,0 +1,217 @@
+"""Store round-trip tests (reference: jepsen/test/jepsen/store_test.clj:
+a full run! round-tripped through serialization; plus path/symlink
+behavior)."""
+
+import datetime
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import core, store
+from jepsen_tpu.history import REGISTER_SCHEMA, Op, invoke_op, ok_op
+from jepsen_tpu.testlib import SharedAtom, cas_test
+
+
+def t0(**kw):
+    test = {
+        "name": "store-test",
+        "start_time": datetime.datetime(2026, 7, 29, 12, 0, 0),
+    }
+    test.update(kw)
+    return test
+
+
+class TestPaths:
+    def test_path_layout(self):
+        p = store.path(t0())
+        assert p == os.path.join(
+            store.BASE_DIR, "store-test", "20260729T120000.000"
+        )
+
+    def test_path_flattens_and_drops_none(self):
+        p = store.path(t0(), "a", [None, "b", ["c"]], None, "d")
+        assert p.endswith(os.path.join("a", "b", "c", "d"))
+
+    def test_path_requires_name_and_time(self):
+        with pytest.raises(AssertionError):
+            store.path({"name": "x"})
+        with pytest.raises(AssertionError):
+            store.path({"start_time": "y"})
+
+    def test_string_start_time_passes_through(self):
+        p = store.path(t0(start_time="raw-time"))
+        assert p.endswith(os.path.join("store-test", "raw-time"))
+
+    def test_store_dir_override(self, tmp_path):
+        p = store.path(t0(store_dir=str(tmp_path / "elsewhere")))
+        assert p.startswith(str(tmp_path / "elsewhere"))
+
+
+HIST = [
+    invoke_op(0, "write", 3, time=10, index=0),
+    ok_op(0, "write", 3, time=20, index=1),
+    invoke_op(1, "read", None, time=30, index=2),
+    ok_op(1, "read", 3, time=40, index=3),
+]
+
+
+class TestSaveLoad:
+    def test_save_and_load_round_trip(self):
+        test = t0(history=list(HIST), results={"valid": True, "count": 4})
+        store.save_1(test)
+        store.save_2(test)
+
+        loaded = store.load("store-test", "20260729T120000.000")
+        assert [o.to_dict() for o in loaded["history"]] == [
+            o.to_dict() for o in HIST
+        ]
+        assert loaded["results"] == {"valid": True, "count": 4}
+        assert store.load_results("store-test", "20260729T120000.000") == {
+            "valid": True,
+            "count": 4,
+        }
+
+    def test_history_txt_written(self):
+        test = t0(history=list(HIST))
+        store.save_1(test)
+        txt = open(store.path(test, "history.txt")).read()
+        assert "write" in txt and txt.count("\n") == 4
+
+    def test_tensor_history_written_with_schema(self):
+        test = t0(history=list(HIST), schema=REGISTER_SCHEMA)
+        store.save_1(test)
+        from jepsen_tpu.history import TensorHistory
+
+        th = TensorHistory.load(store.path(test, "history.npz"))
+        assert [o.f for o in th.decode()] == ["write", "write", "read", "read"]
+
+    def test_nonserializable_keys_stripped(self):
+        test = t0(
+            history=[],
+            checker=object(),
+            client=object(),
+            _history_lock=object(),
+            custom_live=object(),
+            nonserializable_keys=["custom_live"],
+        )
+        store.write_test(test)
+        snap = json.load(open(store.path(test, "test.json")))
+        for k in ("checker", "client", "_history_lock", "custom_live", "history"):
+            assert k not in snap
+
+    def test_unserializable_values_fall_back_to_repr(self):
+        test = t0(history=[], weird={1, 2}, when=datetime.datetime(2026, 1, 1))
+        store.write_test(test)
+        snap = json.load(open(store.path(test, "test.json")))
+        assert snap["weird"] == [1, 2]
+        assert snap["when"].startswith("2026-01-01")
+
+
+class TestSymlinks:
+    def test_latest_and_current(self):
+        a = t0(start_time="20260101T000000.000", history=list(HIST))
+        b = t0(start_time="20260202T000000.000", history=list(HIST))
+        store.save_1(a)
+        store.save_1(b)
+        root = store.base_dir(a)
+        for link in ("latest", "current"):
+            assert os.path.islink(os.path.join(root, link))
+        assert os.path.realpath(os.path.join(root, "latest")) == os.path.realpath(
+            store.path(b)
+        )
+        assert os.path.islink(os.path.join(root, "store-test", "latest"))
+
+    def test_latest_loads_newest(self):
+        store.save_1(t0(start_time="20260101T000000.000", history=list(HIST)))
+        newest = t0(
+            start_time="20260202T000000.000",
+            history=list(HIST),
+            results={"valid": False},
+        )
+        store.save_1(newest)
+        store.save_2(newest)
+        got = store.latest()
+        assert got["start_time"] == "20260202T000000.000"
+        assert got["results"] == {"valid": False}
+
+    def test_latest_empty_store(self):
+        assert store.latest() is None
+
+
+class TestTestsListingAndDelete:
+    def test_listing(self):
+        store.save_1(t0(history=[]))
+        store.save_1(t0(name="other", history=[]))
+        all_tests = store.tests()
+        assert set(all_tests) == {"store-test", "other"}
+        assert list(all_tests["store-test"]) == ["20260729T120000.000"]
+
+    def test_delete(self):
+        test = t0(history=[])
+        store.save_1(test)
+        store.delete("store-test", "20260729T120000.000")
+        assert store.tests("store-test") == {}
+
+    def test_delete_prunes_dangling_latest(self):
+        store.save_1(t0(history=list(HIST)))
+        store.delete("store-test", "20260729T120000.000")
+        # latest symlink dangles after delete; latest() must be None,
+        # not a FileNotFoundError
+        assert store.latest() is None
+
+    def test_delete_falls_back_to_surviving_run(self):
+        old = t0(start_time="20260101T000000.000", history=list(HIST))
+        store.save_1(old)
+        newest = t0(start_time="20260202T000000.000", history=list(HIST))
+        store.save_1(newest)
+        store.delete("store-test", "20260202T000000.000")
+        got = store.latest()
+        assert got["start_time"] == "20260101T000000.000"
+
+    def test_tuple_keyed_results_serialize(self):
+        # independent-checker results are keyed by workload keys, which
+        # may be tuples — JSON keys must stringify, not crash
+        test = t0(history=[], results={"valid": True, ("k", 3): {"valid": True}})
+        store.write_results(test)
+        loaded = json.load(open(store.path(test, "results.json")))
+        assert loaded["('k', 3)"] == {"valid": True}
+
+    def test_logging_level_restored(self):
+        import logging
+
+        root = logging.getLogger("jepsen_tpu")
+        prev = root.level
+        try:
+            root.setLevel(logging.DEBUG)
+            test = t0()
+            store.start_logging(test)
+            store.stop_logging(test)
+            assert root.level == logging.DEBUG
+        finally:
+            root.setLevel(prev)
+
+
+class TestFullRunRoundTrip:
+    def test_engine_run_persists_and_reloads(self):
+        """A full engine run against the atom backend persists history +
+        results, reloadable for offline analysis (store_test.clj:19-36)."""
+        state = SharedAtom()
+        test = core.run(cas_test(state))
+        assert test["results"]["valid"] is True
+        d = store.path(test)
+        for f in ("history.txt", "history.jsonl", "test.json",
+                  "results.json", "jepsen.log"):
+            assert os.path.exists(os.path.join(d, f)), f
+        loaded = store.latest()
+        assert loaded["name"] == "cas-atom"
+        assert len(loaded["history"]) == len(test["history"])
+        assert loaded["results"]["valid"] is True
+        # the log handler was removed at the end of the run
+        assert "_log_handler" not in test
+
+    def test_run_log_contains_engine_lines(self):
+        state = SharedAtom()
+        test = core.run(cas_test(state))
+        logtxt = open(store.path(test, "jepsen.log")).read()
+        assert "Analyzing" in logtxt
