@@ -1,0 +1,326 @@
+"""Command-line runners (reference: jepsen.cli, cli.clj).
+
+Test authors build a main from subcommand maps, exactly like the
+reference's `(cli/run! (merge (cli/single-test-cmd {...}) (cli/serve-cmd))
+args)` (cli.clj:229-304, etcd.clj:183-188):
+
+    from jepsen_tpu import cli
+
+    def my_test(opts): ...
+
+    if __name__ == "__main__":
+        cli.main(
+            {**cli.single_test_cmd(my_test), **cli.serve_cmd()},
+            sys.argv[1:],
+        )
+
+Exit codes (cli.clj:253-304): 0 success, 1 test ran but results were
+invalid, 254 bad arguments / unknown command, 255 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+log = logging.getLogger("jepsen_tpu.cli")
+
+#: The reference's default cluster (cli.clj:17)
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class CliError(Exception):
+    """Bad arguments: exits 254."""
+
+
+class _Parser(argparse.ArgumentParser):
+    """argparse, but option errors raise CliError (exit 254) instead of
+    argparse's exit(2)."""
+
+    def error(self, message):
+        raise CliError(message)
+
+
+def test_opt_spec(parser: argparse.ArgumentParser) -> None:
+    """The standard test options (cli.clj:54-92)."""
+    parser.add_argument(
+        "-n", "--node", action="append", default=None, metavar="HOSTNAME",
+        help="Node to run the test on; repeat for multiple nodes.",
+    )
+    parser.add_argument(
+        "--nodes", default=None, metavar="NODE_LIST",
+        help="Comma-separated list of node hostnames.",
+    )
+    parser.add_argument(
+        "--nodes-file", default=None, metavar="FILENAME",
+        help="File containing node hostnames, one per line.",
+    )
+    parser.add_argument("--username", default="root", help="Username for logins")
+    parser.add_argument("--password", default="root", help="Password for sudo")
+    parser.add_argument(
+        "--strict-host-key-checking", action="store_true", default=False,
+        help="Whether to check host keys",
+    )
+    parser.add_argument(
+        "--ssh-private-key", default=None, metavar="FILE",
+        help="Path to an SSH identity file",
+    )
+    parser.add_argument(
+        "--dummy-ssh", action="store_true", default=False,
+        help="Don't actually SSH; pretend every command succeeds "
+        "(control.clj *dummy* mode)",
+    )
+    parser.add_argument(
+        "--concurrency", default="1n", metavar="NUMBER",
+        help="How many workers? An integer, optionally followed by n "
+        "to multiply by the node count (e.g. 3n).",
+    )
+    parser.add_argument(
+        "--test-count", type=int, default=1, metavar="NUMBER",
+        help="How many times to repeat the test",
+    )
+    parser.add_argument(
+        "--time-limit", type=int, default=60, metavar="SECONDS",
+        help="How long the main body of the test runs, in seconds",
+    )
+    parser.add_argument(
+        "--store-dir", default=None, metavar="DIR",
+        help="Root directory for test results (default ./store)",
+    )
+
+
+def parse_concurrency(opts: dict, key: str = "concurrency") -> dict:
+    """\"3n\" -> 3 * node count; plain integers parse directly
+    (cli.clj:130-145)."""
+    c = str(opts.get(key, "1n"))
+    unit = 1
+    if c.endswith("n"):
+        unit = len(opts.get("nodes") or [])
+        c = c[:-1]
+    try:
+        n = int(c)
+    except ValueError:
+        raise CliError(
+            f"--concurrency {opts.get(key)!r} should be an integer "
+            "optionally followed by n"
+        ) from None
+    opts[key] = n * unit
+    return opts
+
+
+def parse_nodes(opts: dict) -> dict:
+    """Merge --node/--nodes/--nodes-file into a single :nodes list
+    (cli.clj:147-182)."""
+    node = opts.pop("node", None)
+    nodes = opts.pop("nodes", None)
+    nodes_file = opts.pop("nodes_file", None)
+    out: list[str] = []
+    if nodes_file:
+        with open(nodes_file) as f:
+            out.extend(line.strip() for line in f if line.strip())
+    if nodes:
+        out.extend(s.strip() for s in str(nodes).split(",") if s.strip())
+    if node:
+        out.extend(node)
+    opts["nodes"] = out or list(DEFAULT_NODES)
+    return opts
+
+
+def rename_ssh_options(opts: dict) -> dict:
+    """Collect ssh-related options under an :ssh map (cli.clj:200-216)."""
+    opts["ssh"] = {
+        "username": opts.pop("username", "root"),
+        "password": opts.pop("password", "root"),
+        "strict_host_key_checking": opts.pop("strict_host_key_checking", False),
+        "private_key_path": opts.pop("ssh_private_key", None),
+        "dummy": opts.pop("dummy_ssh", False),
+    }
+    return opts
+
+
+def test_opt_fn(opts: dict) -> dict:
+    """The standard transform chain (cli.clj:218-225)."""
+    return parse_concurrency(parse_nodes(rename_ssh_options(opts)))
+
+
+@dataclass
+class Subcommand:
+    """One CLI subcommand (the reference's subcommand-spec map,
+    cli.clj:229-243)."""
+
+    run: Callable[[dict], int | None]
+    opt_spec: Callable[[argparse.ArgumentParser], None] | None = None
+    opt_fn: Callable[[dict], dict] | None = None
+    usage: str | None = None
+    extra_opts: list = field(default_factory=list)
+
+
+def _build_parser(name: str, sub: Subcommand) -> _Parser:
+    p = _Parser(prog=f"{sys.argv[0]} {name}", description=sub.usage)
+    if sub.opt_spec is not None:
+        sub.opt_spec(p)
+    for add in sub.extra_opts:
+        add(p)
+    return p
+
+
+def run_cli(subcommands: dict, argv: list[str]) -> int:
+    """Dispatch a subcommand; returns the process exit code
+    (cli.clj:229-304)."""
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO, format="%(levelname)s [%(name)s] %(message)s"
+        )
+    command = argv[0] if argv else None
+    if command not in subcommands:
+        print(f"Usage: {sys.argv[0]} COMMAND [OPTIONS ...]")
+        print("Commands:", ", ".join(sorted(subcommands)))
+        return 254
+    sub = subcommands[command]
+    parser = _build_parser(command, sub)
+    try:
+        try:
+            ns = parser.parse_args(argv[1:])
+        except CliError as e:
+            print(str(e), file=sys.stderr)
+            return 254
+        opts = vars(ns)
+        if sub.opt_fn is not None:
+            try:
+                opts = sub.opt_fn(opts)
+            except CliError as e:
+                print(str(e), file=sys.stderr)
+                return 254
+        try:
+            code = sub.run(opts)
+        except CliError as e:
+            print(str(e), file=sys.stderr)
+            return 254
+        return int(code) if code else 0
+    except SystemExit as e:  # argparse --help, or a run fn calling sys.exit
+        if isinstance(e.code, int) or e.code is None:
+            return e.code or 0
+        print(e.code, file=sys.stderr)
+        return 255
+    except Exception:  # noqa: BLE001
+        log.exception("Oh jeez, I'm sorry, Jepsen broke. Here's why:")
+        return 255
+
+
+def main(subcommands: dict, argv: list[str] | None = None) -> None:
+    sys.exit(run_cli(subcommands, sys.argv[1:] if argv is None else argv))
+
+
+# ---------------------------------------------------------------------------
+# Standard subcommands
+
+def _run_test(test_fn, opts) -> int:
+    """The `test` subcommand body (cli.clj:355-364): run --test-count
+    times; exit 1 if any run's results are invalid."""
+    from . import core
+
+    for _ in range(int(opts.get("test_count", 1))):
+        test_map = test_fn(dict(opts))
+        if opts.get("store_dir"):
+            test_map.setdefault("store_dir", opts["store_dir"])
+        test = core.run(test_map)
+        valid = (test.get("results") or {}).get("valid")
+        # :unknown does NOT fail the exit code (cli.clj:362: keywords are
+        # truthy); only a definite False (or missing) does.
+        if valid is False or valid is None:
+            return 1
+    return 0
+
+
+def _run_analyze(test_fn, opts) -> int:
+    """The `analyze` subcommand (cli.clj:366-397): rebuild the test from
+    CLI options (fresh checkers), attach the stored history, re-analyze —
+    no cluster needed."""
+    from . import core, store
+
+    cli_test = test_fn(dict(opts))
+    stored = store.latest(store_dir=opts.get("store_dir"))
+    if stored is None:
+        raise RuntimeError("Not sure what the last test was")
+    if stored.get("name") != cli_test.get("name"):
+        raise RuntimeError(
+            f"Stored test ({stored.get('name')}) and CLI test "
+            f"({cli_test.get('name')}) have different names; aborting"
+        )
+    test = {k: v for k, v in stored.items() if k != "results"}
+    test.update(cli_test)
+    test["history"] = stored["history"]
+    test["start_time"] = stored["start_time"]
+    if opts.get("store_dir"):
+        test["store_dir"] = opts["store_dir"]
+    test = core.analyze(test)
+    core.log_results(test)
+    valid = (test.get("results") or {}).get("valid")
+    # Same exit-code contract as the test subcommand: a definite False or
+    # a missing verdict fails; :unknown passes.
+    return 1 if valid is False or valid is None else 0
+
+
+def single_test_cmd(
+    test_fn: Callable[[dict], dict],
+    opt_spec: Callable[[argparse.ArgumentParser], None] | None = None,
+    opt_fn: Callable[[dict], dict] | None = None,
+    usage: str | None = None,
+) -> dict:
+    """`test` + `analyze` subcommands for a test-map constructor
+    (cli.clj:323-397). opt_spec adds suite-specific options; opt_fn
+    composes after test_opt_fn."""
+    fn = (lambda o: opt_fn(test_opt_fn(o))) if opt_fn else test_opt_fn
+    extra = [opt_spec] if opt_spec else []
+    return {
+        "test": Subcommand(
+            run=lambda opts: _run_test(test_fn, opts),
+            opt_spec=test_opt_spec,
+            extra_opts=extra,
+            opt_fn=fn,
+            usage=usage or "Run a test with standard options.",
+        ),
+        "analyze": Subcommand(
+            run=lambda opts: _run_analyze(test_fn, opts),
+            opt_spec=test_opt_spec,
+            extra_opts=extra,
+            opt_fn=fn,
+            usage="Re-analyze the latest stored history with fresh checkers.",
+        ),
+    }
+
+
+def serve_cmd() -> dict:
+    """The `serve` subcommand: web UI over the store (cli.clj:306-321)."""
+
+    def opt_spec(p):
+        p.add_argument("-b", "--host", default="0.0.0.0", help="Bind host")
+        p.add_argument("-p", "--port", type=int, default=8080, help="Bind port")
+        p.add_argument(
+            "--store-dir", default=None, metavar="DIR",
+            help="Root directory for test results (default ./store)",
+        )
+
+    def run(opts):
+        from . import web
+
+        server = web.serve(
+            host=opts["host"], port=opts["port"], store_dir=opts.get("store_dir")
+        )
+        log.info("Listening on http://%s:%s/", opts["host"], server.server_port)
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            server.shutdown()
+        return 0
+
+    return {"serve": Subcommand(run=run, opt_spec=opt_spec)}
+
+
+if __name__ == "__main__":  # the reference's jepsen.cli/-main (cli.clj:399-402)
+    main(serve_cmd())

@@ -347,7 +347,12 @@ def analyze(test) -> dict:
     """Index the history, run the checker, persist results
     (core.clj:506-523)."""
     log.info("Analyzing...")
-    test["history"] = index(test["history"])
+    hist = test["history"]
+    # run() pre-indexes before save_1; skip the second full re-allocation
+    # pass when indexes are already correct (offline analyze of stored
+    # histories may still need it).
+    if any(o.index != i for i, o in enumerate(hist)):
+        test["history"] = index(hist)
     test["results"] = checker_mod.check_safe(
         test["checker"], test, test["history"], {}
     )

@@ -251,9 +251,10 @@ def save_1(test) -> dict:
 def save_2(test) -> dict:
     """Phase 2, after analysis: results + refreshed test snapshot.
     Unlike the reference (store.clj:381-392), the history is NOT
-    rewritten — analysis only adds :index fields, which write_history
-    already derives, and rewriting a 10k+-op history twice per run is
-    wasted I/O."""
+    rewritten: core.run() indexes the history BEFORE save_1 writes it,
+    analysis doesn't mutate it further, and rewriting a 10k+-op history
+    twice per run is wasted I/O. (If you call save_1 with an unindexed
+    history yourself, index it first — this phase won't fix it up.)"""
     write_results(test)
     write_test(test)
     update_symlinks(test)

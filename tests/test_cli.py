@@ -1,0 +1,210 @@
+"""CLI tests (reference: jepsen/test/jepsen/cli_test.clj + the dispatcher
+semantics of cli.clj:229-304)."""
+
+import pytest
+
+from jepsen_tpu import cli, core, store
+from jepsen_tpu.testlib import SharedAtom, cas_test
+
+
+class TestParsing:
+    def test_parse_concurrency_multiplier(self):
+        opts = {"concurrency": "3n", "nodes": ["a", "b", "c", "d", "e"]}
+        assert cli.parse_concurrency(opts)["concurrency"] == 15
+
+    def test_parse_concurrency_plain(self):
+        opts = {"concurrency": "7", "nodes": ["a"]}
+        assert cli.parse_concurrency(opts)["concurrency"] == 7
+
+    def test_parse_concurrency_bad(self):
+        with pytest.raises(cli.CliError):
+            cli.parse_concurrency({"concurrency": "x2", "nodes": []})
+
+    def test_parse_nodes_default(self):
+        assert cli.parse_nodes({})["nodes"] == cli.DEFAULT_NODES
+
+    def test_parse_nodes_merge(self, tmp_path):
+        f = tmp_path / "nodes"
+        f.write_text("f1\nf2\n")
+        opts = {
+            "node": ["x1"],
+            "nodes": "c1, c2",
+            "nodes_file": str(f),
+        }
+        assert cli.parse_nodes(opts)["nodes"] == ["f1", "f2", "c1", "c2", "x1"]
+
+    def test_rename_ssh_options(self):
+        opts = cli.rename_ssh_options(
+            {"username": "u", "password": "p", "strict_host_key_checking": True,
+             "ssh_private_key": "/k", "dummy_ssh": True}
+        )
+        assert opts["ssh"] == {
+            "username": "u",
+            "password": "p",
+            "strict_host_key_checking": True,
+            "private_key_path": "/k",
+            "dummy": True,
+        }
+
+
+def atom_test_fn(opts):
+    """A test-map constructor in the shape suites use (etcd.clj:149-181)."""
+    test = cas_test(SharedAtom())
+    test["nodes"] = opts["nodes"]
+    test["concurrency"] = opts["concurrency"]
+    return test
+
+
+def failing_test_fn(opts):
+    from jepsen_tpu import checker as checker_mod
+
+    class AlwaysInvalid(checker_mod.Checker):
+        def check(self, test, history, opts=None):
+            return {"valid": False}
+
+    test = atom_test_fn(opts)
+    test["checker"] = AlwaysInvalid()
+    return test
+
+
+class TestDispatcher:
+    def test_unknown_command_254(self, capsys):
+        assert cli.run_cli(cli.single_test_cmd(atom_test_fn), ["bogus"]) == 254
+        assert "Commands:" in capsys.readouterr().out
+
+    def test_no_command_254(self):
+        assert cli.run_cli(cli.single_test_cmd(atom_test_fn), []) == 254
+
+    def test_bad_option_254(self, capsys):
+        code = cli.run_cli(
+            cli.single_test_cmd(atom_test_fn), ["test", "--concurrency", "zz"]
+        )
+        assert code == 254
+
+    def test_help_exits_0(self, capsys):
+        code = cli.run_cli(cli.single_test_cmd(atom_test_fn), ["test", "--help"])
+        assert code == 0
+        assert "--concurrency" in capsys.readouterr().out
+
+    def test_internal_error_255(self):
+        def boom(opts):
+            raise RuntimeError("kaboom")
+
+        cmds = {"test": cli.Subcommand(run=boom)}
+        assert cli.run_cli(cmds, ["test"]) == 255
+
+    def test_cli_error_from_run_fn_254(self):
+        def bad_args(opts):
+            raise cli.CliError("unknown workload")
+
+        cmds = {"test": cli.Subcommand(run=bad_args)}
+        assert cli.run_cli(cmds, ["test"]) == 254
+
+    def test_string_sys_exit_255(self):
+        def exit_str(opts):
+            import sys
+
+            sys.exit("a string message")
+
+        cmds = {"test": cli.Subcommand(run=exit_str)}
+        assert cli.run_cli(cmds, ["test"]) == 255
+
+    def test_missing_verdict_exits_1_for_test_and_analyze(self):
+        from jepsen_tpu import checker as checker_mod
+
+        class NoVerdict(checker_mod.Checker):
+            def check(self, test, history, opts=None):
+                return {"valid": "unknown"}
+
+        def unknown_fn(opts):
+            t = atom_test_fn(opts)
+            t["checker"] = NoVerdict()
+            return t
+
+        # :unknown passes (truthy in the reference, cli.clj:362)...
+        assert (
+            cli.run_cli(cli.single_test_cmd(unknown_fn), ["test", "--nodes", "n1"])
+            == 0
+        )
+        assert (
+            cli.run_cli(
+                cli.single_test_cmd(unknown_fn), ["analyze", "--nodes", "n1"]
+            )
+            == 0
+        )
+
+
+class TestTestSubcommand:
+    def test_valid_run_exits_0(self):
+        code = cli.run_cli(
+            cli.single_test_cmd(atom_test_fn),
+            ["test", "--nodes", "n1,n2,n3", "--concurrency", "2n",
+             "--time-limit", "5"],
+        )
+        assert code == 0
+
+    def test_invalid_run_exits_1(self):
+        code = cli.run_cli(
+            cli.single_test_cmd(failing_test_fn),
+            ["test", "--nodes", "n1", "--time-limit", "5"],
+        )
+        assert code == 1
+
+    def test_custom_opt_spec_and_fn(self):
+        seen = {}
+
+        def opt_spec(p):
+            p.add_argument("--workload", default="register")
+
+        def opt_fn(opts):
+            seen.update(opts)
+            return opts
+
+        def test_fn(opts):
+            return atom_test_fn(opts)
+
+        code = cli.run_cli(
+            cli.single_test_cmd(test_fn, opt_spec=opt_spec, opt_fn=opt_fn),
+            ["test", "--workload", "bank", "--nodes", "n1"],
+        )
+        assert code == 0
+        assert seen["workload"] == "bank"
+        assert seen["concurrency"] == 1  # opt_fn composes after test_opt_fn
+
+
+class TestAnalyzeSubcommand:
+    def test_analyze_rechecks_stored_history(self):
+        # run once to populate the store...
+        assert (
+            cli.run_cli(
+                cli.single_test_cmd(atom_test_fn), ["test", "--nodes", "n1,n2"]
+            )
+            == 0
+        )
+        # ...then re-analyze with fresh checkers, no cluster
+        code = cli.run_cli(
+            cli.single_test_cmd(atom_test_fn), ["analyze", "--nodes", "n1,n2"]
+        )
+        assert code == 0
+        # results were re-written
+        found = store._resolve_latest()
+        assert store.load_results(*found)["valid"] is True
+
+    def test_analyze_empty_store_errors(self):
+        code = cli.run_cli(cli.single_test_cmd(atom_test_fn), ["analyze"])
+        assert code == 255
+
+    def test_analyze_name_mismatch(self):
+        assert (
+            cli.run_cli(
+                cli.single_test_cmd(atom_test_fn), ["test", "--nodes", "n1"]
+            )
+            == 0
+        )
+
+        def renamed(opts):
+            t = atom_test_fn(opts)
+            t["name"] = "other-name"
+            return t
+
+        assert cli.run_cli(cli.single_test_cmd(renamed), ["analyze"]) == 255

@@ -1,0 +1,107 @@
+"""Web UI tests (reference: jepsen.web — test table, file browser, zip,
+scope confinement; web.clj:122-134, 200-235, 256-326)."""
+
+import datetime
+import io
+import urllib.request
+import zipfile
+
+import pytest
+
+from jepsen_tpu import store, web
+from jepsen_tpu.history import invoke_op, ok_op
+
+
+@pytest.fixture
+def populated_store(tmp_path):
+    root = str(tmp_path / "webstore")
+    hist = [invoke_op(0, "write", 1, time=1, index=0),
+            ok_op(0, "write", 1, time=2, index=1)]
+    ok = {
+        "name": "good-test",
+        "start_time": "20260101T000000.000",
+        "store_dir": root,
+        "history": hist,
+        "results": {"valid": True},
+    }
+    bad = {
+        "name": "bad-test",
+        "start_time": "20260202T000000.000",
+        "store_dir": root,
+        "history": hist,
+        "results": {"valid": False},
+    }
+    for t in (ok, bad):
+        store.save_1(t)
+        store.save_2(t)
+    return root
+
+
+@pytest.fixture
+def server(populated_store):
+    s = web.serve(host="127.0.0.1", port=0, store_dir=populated_store)
+    yield s
+    s.shutdown()
+
+
+def get(server, path):
+    url = f"http://127.0.0.1:{server.server_port}{path}"
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestWeb:
+    def test_home_table(self, server):
+        status, body = get(server, "/")
+        assert status == 200
+        text = body.decode()
+        assert "good-test" in text and "bad-test" in text
+        assert "valid-true" in text and "valid-false" in text
+        # newest first
+        assert text.index("bad-test") < text.index("good-test")
+
+    def test_dir_browser(self, server):
+        status, body = get(server, "/files/good-test/20260101T000000.000/")
+        assert status == 200
+        assert "history.txt" in body.decode()
+
+    def test_file_view(self, server):
+        status, body = get(
+            server, "/files/good-test/20260101T000000.000/history.txt"
+        )
+        assert status == 200
+        assert b"write" in body
+
+    def test_zip_download(self, server):
+        status, body = get(server, "/files/good-test/20260101T000000.000.zip")
+        assert status == 200
+        z = zipfile.ZipFile(io.BytesIO(body))
+        names = z.namelist()
+        assert any(n.endswith("history.txt") for n in names)
+        assert any(n.endswith("results.json") for n in names)
+
+    def test_path_traversal_forbidden(self, server):
+        status, _ = get(server, "/files/../../etc/passwd")
+        assert status == 403
+
+    def test_zip_of_whole_store_refused(self, server):
+        status, _ = get(server, "/files/good-test.zip")
+        assert status == 404
+        status, _ = get(server, "/files/.zip")
+        assert status in (403, 404)
+
+    def test_symlink_escape_forbidden(self, server, populated_store):
+        import os
+
+        os.symlink("/etc", os.path.join(populated_store, "escape"))
+        status, _ = get(server, "/files/escape/hostname")
+        assert status == 403
+
+    def test_missing_404(self, server):
+        status, _ = get(server, "/files/nope/nothing")
+        assert status == 404
+        status, _ = get(server, "/bogus")
+        assert status == 404
