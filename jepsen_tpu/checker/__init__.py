@@ -107,18 +107,33 @@ from .basic import (  # noqa: E402
     unique_ids,
 )
 from .linearizable import linearizable  # noqa: E402
+from .clock import clock_plot  # noqa: E402
+from .timeline import html as timeline_html  # noqa: E402
+# NB: the composite perf checker is exported as perf_checker — the bare
+# name `perf` is taken by the jepsen_tpu.checker.perf submodule, and a
+# same-named function would be clobbered by any submodule import.
+from .perf import (  # noqa: E402
+    latency_graph,
+    perf as perf_checker,
+    rate_graph_checker as rate_graph,
+)
 
 __all__ = [
     "Checker",
     "check_safe",
+    "clock_plot",
     "compose",
     "concurrency_limit",
     "counter",
+    "latency_graph",
     "linearizable",
     "merge_valid",
+    "perf_checker",
     "queue",
+    "rate_graph",
     "set_checker",
     "set_full",
+    "timeline_html",
     "total_queue",
     "unbridled_optimism",
     "unique_ids",

@@ -1,0 +1,193 @@
+"""Plot/report checker tests (reference: jepsen/test/jepsen/
+checker/perf_test.clj — literal 100-op history plus a 10k random history
+smoke test; timeline + clock analogs)."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import clock as clock_mod
+from jepsen_tpu.checker import perf, timeline
+from jepsen_tpu.history import Op, index, invoke_op, ok_op
+
+
+def small_history():
+    """A hand-written history with nemesis windows (perf_test.clj:16-80
+    shape)."""
+    s = lambda sec: int(sec * 1e9)  # noqa: E731
+    h = [
+        Op("nemesis", "info", "start", None, time=s(2)),
+        Op("nemesis", "info", "start", None, time=s(2.1)),
+        invoke_op(0, "read", None, time=s(1)),
+        ok_op(0, "read", 3, time=s(1.5)),
+        invoke_op(1, "write", 4, time=s(3)),
+        Op(1, "info", "write", 4, time=s(3.2), error="timeout"),
+        invoke_op(2, "cas", (1, 2), time=s(4)),
+        Op(2, "fail", "cas", (1, 2), time=s(4.1)),
+        Op("nemesis", "info", "stop", None, time=s(5)),
+        Op("nemesis", "info", "stop", None, time=s(5.1)),
+        invoke_op(3, "read", None, time=s(6)),
+        ok_op(3, "read", 4, time=s(7)),
+    ]
+    return index(h)
+
+
+def random_history(n=10_000, seed=0):
+    rng = random.Random(seed)
+    h = []
+    t = 0
+    for i in range(n // 2):
+        proc = rng.randrange(10)
+        f = rng.choice(["read", "write", "cas"])
+        t += rng.randrange(1, 10**6)
+        h.append(invoke_op(proc, f, rng.randrange(5), time=t))
+        t += rng.randrange(1, 10**6)
+        typ = rng.choice(["ok", "ok", "ok", "fail", "info"])
+        h.append(Op(proc, typ, f, rng.randrange(5), time=t))
+    # histories interleave properly only if each process has one open op;
+    # simplest: remap process per pair
+    fixed, open_p = [], set()
+    p = 0
+    for i in range(0, len(h), 2):
+        fixed.append(h[i].with_(process=p))
+        fixed.append(h[i + 1].with_(process=p))
+        p += 1
+    return index(fixed)
+
+
+def t0(tmp_path, **kw):
+    d = {"name": "perf-test", "start_time": "20260729T000000.000",
+         "store_dir": str(tmp_path)}
+    d.update(kw)
+    return d
+
+
+class TestBuckets:
+    def test_bucket_time(self):
+        assert perf.bucket_time(10, 3) == 5.0
+        assert perf.bucket_time(10, 11) == 15.0
+
+    def test_buckets(self):
+        assert list(perf.buckets(10, 30)) == [5.0, 15.0, 25.0, 35.0]
+
+    def test_quantile_points_reference_indexing(self):
+        # floor(n*q) clamped to n-1 (perf.clj:47-57)
+        pts = perf.quantile_points(10, [0.5, 1.0], [1, 2, 3, 4], [10, 20, 30, 40])
+        assert pts[0.5][1] == [30]  # floor(4*.5)=2 -> sorted[2]
+        assert pts[1.0][1] == [40]
+
+    def test_nemesis_spans(self):
+        spans = perf.nemesis_spans(small_history())
+        assert len(spans) == 2
+        assert spans[0] == (2.0, 5.0)
+        assert spans[1] == (2.1, 5.1)
+
+
+class TestGraphs:
+    def test_point_graph_writes_png(self, tmp_path):
+        test = t0(tmp_path)
+        p = perf.point_graph(test, small_history(), {})
+        assert p is not None and os.path.getsize(p) > 1000
+        assert p.endswith("latency-raw.png")
+
+    def test_quantiles_graph_writes_png(self, tmp_path):
+        p = perf.quantiles_graph(t0(tmp_path), small_history(), {})
+        assert p is not None and os.path.getsize(p) > 1000
+
+    def test_rate_graph_writes_png(self, tmp_path):
+        p = perf.rate_graph(t0(tmp_path), small_history(), {})
+        assert p is not None and os.path.getsize(p) > 1000
+
+    def test_perf_checker_composite(self, tmp_path):
+        test = t0(tmp_path)
+        r = perf.perf().check(test, small_history(), {})
+        assert r["valid"] is True
+        base = os.path.join(str(tmp_path), "perf-test", "20260729T000000.000")
+        for f in ("latency-raw.png", "latency-quantiles.png", "rate.png"):
+            assert os.path.exists(os.path.join(base, f)), f
+
+    def test_subdirectory_opt(self, tmp_path):
+        p = perf.rate_graph(t0(tmp_path), small_history(),
+                            {"subdirectory": ["independent", "3"]})
+        assert os.sep + os.path.join("independent", "3", "rate.png") in p
+
+    def test_empty_history_no_crash(self, tmp_path):
+        assert perf.point_graph(t0(tmp_path), [], {}) is None
+        assert perf.rate_graph(t0(tmp_path), [], {}) is None
+
+    @pytest.mark.slow
+    def test_10k_random_history_smoke(self, tmp_path):
+        test = t0(tmp_path)
+        r = perf.perf().check(test, random_history(), {})
+        assert r["valid"] is True
+
+
+class TestTimeline:
+    def test_pairs(self):
+        ps = timeline.op_pairs(small_history())
+        # 2 nemesis starts (unmatched infos), 4 client windows,
+        # 2 nemesis stops
+        kinds = [(p[0].process, p[1] is not None) for p in ps]
+        assert ("nemesis", False) in kinds
+        client = [p for p in ps if isinstance(p[0].process, int)]
+        assert len(client) == 4
+        assert all(p[1] is not None for p in client)
+
+    def test_html_written(self, tmp_path):
+        test = t0(tmp_path)
+        r = timeline.html().check(test, small_history(), {})
+        assert r["valid"] is True
+        p = os.path.join(str(tmp_path), "perf-test", "20260729T000000.000",
+                         "timeline.html")
+        doc = open(p).read()
+        assert "op ok" in doc and "op fail" in doc and "op info" in doc
+        assert "timeline" in doc
+
+    def test_render_no_store(self):
+        # renders standalone without writing when test has no name
+        doc = timeline.render({}, small_history())
+        assert doc.startswith("<!doctype html>")
+
+
+class TestClock:
+    def clock_history(self):
+        s = lambda sec: int(sec * 1e9)  # noqa: E731
+        return index([
+            Op("nemesis", "info", "start", None, time=s(1),
+               extra={"clock_offsets": {"n1.example.com": 0.0,
+                                        "n2.example.com": 0.0}}),
+            Op("nemesis", "info", "bump", {"n1.example.com": 2.2}, time=s(2),
+               extra={"clock_offsets": {"n1.example.com": 2.2,
+                                        "n2.example.com": 0.0}}),
+            Op("nemesis", "info", "stop", None, time=s(3),
+               extra={"clock_offsets": {"n1.example.com": 0.1,
+                                        "n2.example.com": 0.0}}),
+            invoke_op(0, "read", None, time=s(4)),
+            ok_op(0, "read", 1, time=s(5)),
+        ])
+
+    def test_datasets(self):
+        ds = clock_mod.history_datasets(self.clock_history())
+        assert set(ds) == {"n1.example.com", "n2.example.com"}
+        xs, ys = ds["n1.example.com"]
+        assert ys[:3] == [0.0, 2.2, 0.1]
+        assert xs[-1] == 5.0  # extended to final time
+
+    def test_short_node_names(self):
+        assert clock_mod.short_node_names(
+            ["n1.example.com", "n2.example.com"]
+        ) == ["n1", "n2"]
+        assert clock_mod.short_node_names(["a", "b"]) == ["a", "b"]
+
+    def test_plot_written(self, tmp_path):
+        test = t0(tmp_path)
+        r = clock_mod.clock_plot().check(test, self.clock_history(), {})
+        assert r["valid"] is True
+        p = os.path.join(str(tmp_path), "perf-test", "20260729T000000.000",
+                         "clock-skew.png")
+        assert os.path.getsize(p) > 1000
+
+    def test_no_offsets_no_plot(self, tmp_path):
+        assert clock_mod.plot(t0(tmp_path), small_history(), {}) is None
