@@ -13,7 +13,7 @@ from typing import Mapping
 
 from ..util import nanos_to_secs
 from . import Checker
-from .perf import _decorate, _out_path, _plt
+from .perf import _decorate, load_pyplot, out_path
 
 log = logging.getLogger("jepsen_tpu.checker.clock")
 
@@ -61,10 +61,10 @@ def short_node_names(nodes) -> list[str]:
 def plot(test, history, opts) -> str | None:
     """clock-skew.png (clock.clj:47-73)."""
     datasets = history_datasets(history)
-    path = _out_path(test, opts, "clock-skew.png")
+    path = out_path(test, opts, "clock-skew.png")
     if not datasets or path is None:
         return None
-    plt = _plt()
+    plt = load_pyplot()
     fig, ax = plt.subplots(figsize=(9, 4), dpi=100)
     nodes = sorted(datasets)
     for node, label in zip(nodes, short_node_names(nodes)):

@@ -37,7 +37,7 @@ QUANTILE_COLORS = {0.5: "#81BFFC", 0.95: "#f9b447", 0.99: "#FF1E90",
                    1.0: "#888888"}
 
 
-def _plt():
+def load_pyplot():
     import matplotlib
 
     matplotlib.use("Agg", force=False)
@@ -136,7 +136,7 @@ def _decorate(ax, history, test, title, ylabel):
     ax.set_ylabel(ylabel)
 
 
-def _out_path(test, opts, filename: str) -> str | None:
+def out_path(test, opts, filename: str) -> str | None:
     if not (test.get("name") and test.get("start_time")):
         return None
     from .. import store
@@ -148,10 +148,10 @@ def _out_path(test, opts, filename: str) -> str | None:
 def point_graph(test, history, opts) -> str | None:
     """latency-raw.png (perf.clj:251-303)."""
     rows = _latency_data(history)
-    path = _out_path(test, opts, "latency-raw.png")
+    path = out_path(test, opts, "latency-raw.png")
     if not rows or path is None:
         return None
-    plt = _plt()
+    plt = load_pyplot()
     fig, ax = plt.subplots(figsize=(9, 4), dpi=100)
     fs = sorted({r[0] for r in rows})
     markers = {f: m for f, m in zip(fs, "ox+s^v*D")}
@@ -175,10 +175,10 @@ def point_graph(test, history, opts) -> str | None:
 def quantiles_graph(test, history, opts, dt=30, qs=QUANTILES) -> str | None:
     """latency-quantiles.png (perf.clj:305-347)."""
     rows = _latency_data(history)
-    path = _out_path(test, opts, "latency-quantiles.png")
+    path = out_path(test, opts, "latency-quantiles.png")
     if not rows or path is None:
         return None
-    plt = _plt()
+    plt = load_pyplot()
     fig, ax = plt.subplots(figsize=(9, 4), dpi=100)
     fs = sorted({r[0] for r in rows})
     markers = {f: m for f, m in zip(fs, "ox+s^v*D")}
@@ -206,12 +206,12 @@ def rate_graph(test, history, opts, dt=10) -> str | None:
         if not o.is_invoke and isinstance(o.process, int)
         and o.time is not None and o.time >= 0
     ]
-    path = _out_path(test, opts, "rate.png")
+    path = out_path(test, opts, "rate.png")
     if not rows or path is None:
         return None
     t_max = max(r[2] for r in rows)
     centers = buckets(dt, t_max)
-    plt = _plt()
+    plt = load_pyplot()
     fig, ax = plt.subplots(figsize=(9, 4), dpi=100)
     fs = sorted({r[0] for r in rows})
     markers = {f: m for f, m in zip(fs, "ox+s^v*D")}

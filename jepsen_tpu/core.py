@@ -180,8 +180,34 @@ class ClientWorker(Worker):
         self.client = None
         self.name = f"worker {process}"
 
+    def _open_client(self):
+        """open then setup, like the reference's open-compat!
+        (client.clj:38-51); the connection is closed if setup fails."""
+        client = self.test["client"].open(self.test, self.node)
+        try:
+            client.setup(self.test)
+        except BaseException:
+            try:
+                client.close(self.test)
+            except Exception:  # noqa: BLE001
+                log.warning("Error closing client after failed setup",
+                            exc_info=True)
+            raise
+        return client
+
+    def _close_client(self):
+        """teardown then close, like the reference's close-compat!
+        (client.clj:62-70); close always runs."""
+        client, self.client = self.client, None
+        if client is None:
+            return
+        try:
+            client.teardown(self.test)
+        finally:
+            client.close(self.test)
+
     def setup(self):
-        self.client = self.test["client"].open(self.test, self.node)
+        self.client = self._open_client()
 
     def run(self):
         test = self.test
@@ -200,7 +226,7 @@ class ClientWorker(Worker):
             log_op_logger(op)
             if self.client is None:
                 try:
-                    self.client = test["client"].open(test, self.node)
+                    self.client = self._open_client()
                 except Exception as e:  # noqa: BLE001
                     log.warning("Error opening client", exc_info=True)
                     fail = op.with_(
@@ -222,15 +248,12 @@ class ClientWorker(Worker):
                 # logical process stays single-threaded (core.clj:410-427).
                 self.process += test["concurrency"]
                 try:
-                    self.client.close(test)
+                    self._close_client()
                 except Exception:  # noqa: BLE001
                     log.warning("Error closing client", exc_info=True)
-                self.client = None
 
     def teardown(self):
-        if self.client is not None:
-            self.client.close(self.test)
-            self.client = None
+        self._close_client()
 
 
 class NemesisWorker(Worker):

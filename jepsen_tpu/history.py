@@ -146,6 +146,11 @@ def index(history: Sequence[Op]) -> list[Op]:
     return [o.with_(index=i) for i, o in enumerate(history)]
 
 
+def ops(history: Iterable) -> list[Op]:
+    """Coerce a whole history of dicts/Ops to Op records."""
+    return [op(o) for o in history]
+
+
 def client_ops(history: Iterable[Op]) -> list[Op]:
     """Only ops from integer (client) processes."""
     return [o for o in history if isinstance(o.process, int)]

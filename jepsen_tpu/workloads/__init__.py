@@ -1,0 +1,7 @@
+"""Workload bundles: generator + checker (+ model) packages for standard
+consistency tests, mirroring the reference's jepsen.tests.* namespaces
+(SURVEY.md §2.1). Each module exposes a `test(...)`/`workload(...)`
+builder returning a partial test map — callers supply the client and DB.
+"""
+
+from . import adya, bank, causal, linearizable_register, long_fork  # noqa: F401

@@ -1,0 +1,85 @@
+"""Adya G2 anti-dependency-cycle workload: for each key, two concurrent
+transactions each predicate-read both tables and insert one row; under
+serializability at most one insert per key may commit (reference:
+jepsen/src/jepsen/tests/adya.clj:1-89; see Adya's thesis for G2).
+
+Clients take ops {"f": "insert", "value": (key, (a_id, b_id))} where
+exactly one of a_id/b_id is set, predicate-read both tables for the key,
+and insert into table a or b iff both reads came back empty."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from .. import generator as gen
+from .. import independent
+from ..checker import Checker
+from ..history import ops as _ops
+
+
+class _IdSource:
+    def __init__(self):
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._n += 1
+            return self._n
+
+
+def g2_gen() -> gen.Generator:
+    """Pairs of insert ops per key, ids globally unique; one txn holds
+    a_id, the other b_id (adya.clj:13-61)."""
+    ids = _IdSource()
+    return independent.concurrent_generator(
+        2,
+        itertools.count(),
+        lambda k: gen.seq(
+            [
+                lambda t, p: {
+                    "type": "invoke",
+                    "f": "insert",
+                    "value": (None, ids.next()),
+                },
+                lambda t, p: {
+                    "type": "invoke",
+                    "f": "insert",
+                    "value": (ids.next(), None),
+                },
+            ]
+        ),
+    )
+
+
+class G2Checker(Checker):
+    """At most one insert may succeed per key (adya.clj:63-89)."""
+
+    def check(self, test, history, opts=None) -> dict:
+        keys: dict = {}
+        for op in _ops(history):
+            if op.f != "insert" or not independent.is_tuple(op.value):
+                continue
+            k = op.value.key
+            if op.is_ok:
+                keys[k] = keys.get(k, 0) + 1
+            else:
+                keys.setdefault(k, 0)
+        insert_count = sum(1 for c in keys.values() if c > 0)
+        illegal = {k: c for k, c in sorted(keys.items()) if c > 1}
+        return {
+            "valid": not illegal,
+            "key-count": len(keys),
+            "legal-count": insert_count - len(illegal),
+            "illegal-count": len(illegal),
+            "illegal": illegal,
+        }
+
+
+def g2_checker() -> G2Checker:
+    return G2Checker()
+
+
+def workload() -> dict:
+    return {"checker": g2_checker(), "generator": g2_gen()}

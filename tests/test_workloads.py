@@ -1,0 +1,427 @@
+"""Workload-bundle tests (reference semantics: jepsen.tests.*, SURVEY.md
+§2.1) — bank, linearizable-register, causal, long-fork, adya, txn."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import core, generator as gen, independent, txn as mop
+from jepsen_tpu.history import Op, fail_op, invoke_op, ok_op
+from jepsen_tpu.testlib import AtomDB, SharedAtom, noop_test
+from jepsen_tpu.workloads import adya, bank, causal, linearizable_register, long_fork
+
+
+class TestTxn:
+    def test_accessors(self):
+        m = ["r", 3, None]
+        assert mop.f(m) == "r"
+        assert mop.key(m) == 3
+        assert mop.value(m) is None
+        assert mop.is_read(m) and not mop.is_write(m)
+        assert mop.is_op(m)
+        assert mop.is_op(["w", 1, 2])
+        assert not mop.is_op(["x", 1, 2])
+        assert not mop.is_op(["r", 1])
+        assert not mop.is_op(None)
+
+
+def _bank_test(**over):
+    t = noop_test()
+    t.update(bank.test())
+    t.update(over)
+    return t
+
+
+class TestBankChecker:
+    def _check(self, history, **over):
+        return bank.checker().check(_bank_test(**over), history)
+
+    def test_valid(self):
+        h = [
+            invoke_op(0, "read"),
+            ok_op(0, "read", {a: (100 if a == 0 else 0) for a in range(8)}),
+        ]
+        r = self._check(h)
+        assert r["valid"] is True
+        assert r["read-count"] == 1
+        assert r["error-count"] == 0
+
+    def test_wrong_total(self):
+        h = [
+            invoke_op(0, "read"),
+            ok_op(0, "read", {a: 0 for a in range(8)}, index=1),
+        ]
+        r = self._check(h)
+        assert r["valid"] is False
+        assert "wrong-total" in r["errors"]
+        e = r["errors"]["wrong-total"]
+        assert e["count"] == 1 and e["lowest"]["total"] == 0
+        assert r["first-error"]["type"] == "wrong-total"
+
+    def test_negative_value(self):
+        v = {a: 0 for a in range(8)}
+        v[0], v[1] = -5, 105
+        r = self._check([invoke_op(0, "read"), ok_op(0, "read", v)])
+        assert r["valid"] is False
+        assert "negative-value" in r["errors"]
+
+    def test_nil_balance_and_unexpected_key(self):
+        v = {a: 0 for a in range(8)}
+        v[3] = None
+        r = self._check([invoke_op(0, "read"), ok_op(0, "read", v)])
+        assert r["valid"] is False and "nil-balance" in r["errors"]
+        v2 = {a: 0 for a in range(9)}  # key 8 not an account
+        r2 = self._check([invoke_op(0, "read"), ok_op(0, "read", v2)])
+        assert r2["valid"] is False and "unexpected-key" in r2["errors"]
+
+    def test_worst_error_by_badness(self):
+        t = _bank_test()
+        h = []
+        for i, total in enumerate([99, 0]):
+            v = {a: 0 for a in range(8)}
+            v[0] = total
+            h.append(invoke_op(0, "read", index=2 * i))
+            h.append(ok_op(0, "read", v, index=2 * i + 1))
+        r = bank.checker().check(t, h)
+        worst = r["errors"]["wrong-total"]["worst"]
+        assert worst["total"] == 0  # |0-100|/100 = 1 > |99-100|/100
+
+    def test_failed_reads_ignored(self):
+        r = self._check([invoke_op(0, "read"), fail_op(0, "read", None)])
+        assert r["valid"] is True and r["read-count"] == 0
+
+    def test_err_badness(self):
+        t = _bank_test()
+        assert bank.err_badness(t, {"type": "unexpected-key", "unexpected": [9, 10]}) == 2
+        assert bank.err_badness(t, {"type": "wrong-total", "total": 50}) == 0.5
+        assert bank.err_badness(t, {"type": "negative-value", "negative": [-3, -4]}) == 7
+
+
+class BankClient(client_mod.Client):
+    """In-process snapshot-isolated bank: balances under one lock."""
+
+    def __init__(self, state: SharedAtom):
+        self.state = state
+
+    def open(self, test, node):
+        return self
+
+    def setup(self, test):
+        accounts = test["accounts"]
+        with self.state.lock:
+            if not isinstance(self.state.value, dict):
+                bal = {a: 0 for a in accounts}
+                bal[accounts[0]] = test["total_amount"]
+                self.state.value = bal
+
+    def invoke(self, test, op):
+        s = self.state
+        if op.f == "read":
+            with s.lock:
+                return op.with_(type="ok", value=dict(s.value))
+        if op.f == "transfer":
+            v = op.value
+            with s.lock:
+                if s.value[v["from"]] < v["amount"]:
+                    return op.with_(type="fail", error="insufficient")
+                s.value[v["from"]] -= v["amount"]
+                s.value[v["to"]] += v["amount"]
+            return op.with_(type="ok")
+        raise ValueError(op.f)
+
+
+class TestBankEndToEnd:
+    def test_engine_run_valid(self):
+        state = SharedAtom()
+        t = _bank_test(
+            name="bank-atom",
+            db=AtomDB(state),
+            client=BankClient(state),
+        )
+        t["generator"] = gen.clients(gen.time_limit(2, gen.limit(300, t["generator"])))
+        t = core.run(t)
+        assert t["results"]["valid"] is True, t["results"]
+        reads = [o for o in t["history"] if o.is_ok and o.f == "read"]
+        assert reads, "no reads completed"
+
+    def test_generator_never_self_transfers(self):
+        t = _bank_test()
+        g = bank.diff_transfer()
+        with gen.with_threads([0, 1]):
+            for _ in range(50):
+                op = g.op(t, 0)
+                assert op["value"]["from"] != op["value"]["to"]
+
+
+class TestBankPlotter:
+    def test_plot_smoke(self, tmp_path):
+        import datetime
+
+        t = _bank_test(name="bank-plot", start_time=datetime.datetime.now())
+        t["_store_root"] = str(tmp_path)
+        h = []
+        for i in range(20):
+            v = {a: 0 for a in range(8)}
+            v[0] = 100
+            h.append(invoke_op(i % 3, "read", time=i * 10**9, index=2 * i))
+            h.append(ok_op(i % 3, "read", v, time=i * 10**9 + 100, index=2 * i + 1))
+        r = bank.plotter().check(t, h)
+        assert r["valid"] is True
+
+
+class TestLinearizableRegister:
+    def test_bundle_shape(self):
+        t = linearizable_register.test({"nodes": ["n1", "n2"]})
+        assert t["model"] is not None
+        assert isinstance(t["generator"], gen.Generator)
+
+    def test_generator_ops_are_tuples(self):
+        opts = {"nodes": ["n1", "n2"], "per_key_limit": 10}
+        bundle = linearizable_register.test(opts)
+        t = noop_test()
+        t.update(bundle)
+        t["concurrency"] = 8
+        threads = list(range(8))
+        seen = []
+        with gen.with_threads(threads):
+            for _ in range(40):
+                op = bundle["generator"].op(t, 0)
+                if op is None:
+                    break
+                seen.append(op)
+        assert seen
+        for op in seen:
+            assert independent.is_tuple(op["value"])
+            assert op["f"] in ("read", "write", "cas")
+
+    def test_checker_catches_bad_subhistory(self):
+        opts = {"nodes": ["n1"], "algorithm": "host"}
+        bundle = linearizable_register.test(opts)
+        t = noop_test()
+        t.update(bundle)
+        k = 0
+        h = [
+            invoke_op(0, "write", independent.tuple_(k, 1), index=0, time=0),
+            ok_op(0, "write", independent.tuple_(k, 1), index=1, time=1),
+            invoke_op(1, "read", independent.tuple_(k, None), index=2, time=2),
+            ok_op(1, "read", independent.tuple_(k, 2), index=3, time=3),
+        ]
+        r = bundle["checker"].check(t, h, {})
+        assert r["valid"] is False
+
+
+class TestCausal:
+    def _op(self, f, value, position, link, type="ok"):
+        return Op(
+            process=0,
+            type=type,
+            f=f,
+            value=value,
+            extra={"position": position, "link": link},
+        )
+
+    def test_valid_causal_order(self):
+        ops = [
+            self._op("read-init", 0, 1, "init"),
+            self._op("write", 1, 2, 1),
+            self._op("read", 1, 3, 2),
+            self._op("write", 2, 4, 3),
+            self._op("read", 2, 5, 4),
+        ]
+        r = causal.check().check({"model": causal.causal_register()}, ops)
+        assert r["valid"] is True, r
+
+    def test_broken_link(self):
+        ops = [
+            self._op("read-init", 0, 1, "init"),
+            self._op("write", 1, 2, 99),  # links to unseen position
+        ]
+        r = causal.check().check({"model": causal.causal_register()}, ops)
+        assert r["valid"] is False
+        assert "Cannot link" in r["error"]
+
+    def test_stale_read(self):
+        ops = [
+            self._op("read-init", 0, 1, "init"),
+            self._op("write", 1, 2, 1),
+            self._op("read", 0, 3, 2),  # reads old value after write
+        ]
+        r = causal.check().check({"model": causal.causal_register()}, ops)
+        assert r["valid"] is False
+        assert "can't read" in r["error"]
+
+    def test_write_must_match_counter(self):
+        ops = [self._op("write", 5, 1, "init")]
+        r = causal.check().check({"model": causal.causal_register()}, ops)
+        assert r["valid"] is False
+
+    def test_read_init_nonzero_on_fresh(self):
+        ops = [self._op("read-init", 7, 1, "init")]
+        r = causal.check().check({"model": causal.causal_register()}, ops)
+        assert r["valid"] is False
+
+    def test_bundle(self):
+        t = causal.test({"time_limit": 1})
+        assert isinstance(t["generator"], gen.Generator)
+        assert t["model"] is not None
+
+
+def _read(process, kvs, type="ok", index=0):
+    value = [[mop.READ, k, v] for k, v in kvs]
+    return Op(process=process, type=type, f="read", value=value, index=index)
+
+
+def _write(process, k, type="invoke", index=0):
+    return Op(
+        process=process, type=type, f="write", value=[[mop.WRITE, k, 1]], index=index
+    )
+
+
+class TestLongFork:
+    def test_group_for(self):
+        assert list(long_fork.group_for(2, 0)) == [0, 1]
+        assert list(long_fork.group_for(2, 5)) == [4, 5]
+        assert list(long_fork.group_for(3, 7)) == [6, 7, 8]
+
+    def test_read_txn_for(self):
+        t = long_fork.read_txn_for(2, 4)
+        assert sorted(mop.key(m) for m in t) == [4, 5]
+        assert all(mop.is_read(m) for m in t)
+
+    def test_read_compare(self):
+        rc = long_fork.read_compare
+        assert rc({0: 1, 1: None}, {0: 1, 1: None}) == 0
+        assert rc({0: 1, 1: 1}, {0: 1, 1: None}) == -1
+        assert rc({0: 1, 1: None}, {0: 1, 1: 1}) == 1
+        assert rc({0: 1, 1: None}, {0: None, 1: 1}) is None
+        with pytest.raises(long_fork.IllegalHistory):
+            rc({0: 1}, {1: 1})
+        with pytest.raises(long_fork.IllegalHistory):
+            rc({0: 1, 1: 2}, {0: 1, 1: 3})
+
+    def test_find_forks_classic(self):
+        # T3 sees x only; T4 sees y only — the canonical long fork
+        t3 = _read(0, [(0, 1), (1, None)])
+        t4 = _read(1, [(0, None), (1, 1)])
+        r0 = _read(2, [(0, None), (1, None)])
+        forks = long_fork.find_forks([r0, t3, t4])
+        assert len(forks) == 1
+        assert {id(forks[0][0]), id(forks[0][1])} == {id(t3), id(t4)}
+
+    def test_find_forks_total_order_ok(self):
+        rs = [
+            _read(0, [(0, None), (1, None)]),
+            _read(1, [(0, 1), (1, None)]),
+            _read(2, [(0, 1), (1, 1)]),
+        ]
+        assert long_fork.find_forks(rs) == []
+
+    def test_checker_detects_fork(self):
+        h = [
+            _write(0, 0, type="invoke", index=0),
+            _write(0, 0, type="ok", index=1),
+            _write(1, 1, type="invoke", index=2),
+            _write(1, 1, type="ok", index=3),
+            _read(2, [(0, 1), (1, None)], index=4),
+            _read(3, [(0, None), (1, 1)], index=5),
+        ]
+        r = long_fork.checker(2).check({}, h)
+        assert r["valid"] is False
+        assert r["forks"]
+
+    def test_checker_valid(self):
+        h = [
+            _write(0, 0, type="invoke", index=0),
+            _write(0, 0, type="ok", index=1),
+            _read(2, [(0, 1), (1, None)], index=2),
+            _read(3, [(0, 1), (1, None)], index=3),
+        ]
+        r = long_fork.checker(2).check({}, h)
+        assert r["valid"] is True
+        assert r["reads-count"] == 2
+
+    def test_checker_multiple_writes_unknown(self):
+        h = [
+            _write(0, 0, type="invoke"),
+            _write(1, 0, type="invoke"),
+        ]
+        r = long_fork.checker(2).check({}, h)
+        assert r["valid"] == "unknown"
+        assert r["error"][0] == "multiple-writes"
+
+    def test_early_late_reads(self):
+        rs = [
+            _read(0, [(0, None), (1, None)]),
+            _read(1, [(0, 1), (1, 1)]),
+            _read(2, [(0, 1), (1, None)]),
+        ]
+        r = long_fork.checker(2).check({}, rs)
+        assert r["early-read-count"] == 1
+        assert r["late-read-count"] == 1
+
+    def test_generator_write_then_group_read(self):
+        g = long_fork.generator(2)
+        t = noop_test()
+        t["concurrency"] = 2
+        with gen.with_threads([0, 1]):
+            o1 = g.op(t, 0)
+            assert o1["f"] == "write"
+            k = mop.key(o1["value"][0])
+            # same worker's next op must read k's group
+            o2 = g.op(t, 0)
+            assert o2["f"] == "read"
+            assert sorted(mop.key(m) for m in o2["value"]) == sorted(
+                long_fork.group_for(2, k)
+            )
+
+    def test_mismatched_group_size_unknown(self):
+        h = [_read(0, [(0, 1)])]
+        r = long_fork.checker(2).check({}, h)
+        assert r["valid"] == "unknown"
+
+
+class TestAdya:
+    def test_checker_valid(self):
+        h = [
+            invoke_op(0, "insert", independent.tuple_(0, (None, 1))),
+            ok_op(0, "insert", independent.tuple_(0, (None, 1))),
+            invoke_op(1, "insert", independent.tuple_(0, (2, None))),
+            fail_op(1, "insert", independent.tuple_(0, (2, None))),
+        ]
+        r = adya.g2_checker().check({}, h)
+        assert r["valid"] is True
+        assert r["key-count"] == 1
+        assert r["legal-count"] == 1
+
+    def test_checker_illegal_double_insert(self):
+        h = [
+            ok_op(0, "insert", independent.tuple_(5, (None, 1))),
+            ok_op(1, "insert", independent.tuple_(5, (2, None))),
+        ]
+        r = adya.g2_checker().check({}, h)
+        assert r["valid"] is False
+        assert r["illegal"] == {5: 2}
+        assert r["illegal-count"] == 1
+
+    def test_gen_unique_ids_and_pairing(self):
+        g = adya.g2_gen()
+        t = noop_test()
+        t["concurrency"] = 4
+        ids = []
+        ops = []
+        with gen.with_threads(list(range(4))):
+            for p in [0, 1, 2, 3] * 4:
+                op = g.op(t, p)
+                if op is None:
+                    continue
+                ops.append(op)
+                a, b = op["value"].value
+                assert (a is None) != (b is None)
+                ids.append(a if a is not None else b)
+        assert len(ids) == len(set(ids)), "ids must be globally unique"
+        # at most two inserts per key
+        from collections import Counter
+
+        per_key = Counter(op["value"].key for op in ops)
+        assert all(c <= 2 for c in per_key.values())
