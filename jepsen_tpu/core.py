@@ -226,7 +226,9 @@ class ClientWorker(Worker):
             log_op_logger(op)
             if self.client is None:
                 try:
-                    self.client = self._open_client()
+                    # bare open — no setup: reconnection after a crash must
+                    # not re-run one-time DB state setup (core.clj:389)
+                    self.client = test["client"].open(test, self.node)
                 except Exception as e:  # noqa: BLE001
                     log.warning("Error opening client", exc_info=True)
                     fail = op.with_(
@@ -248,9 +250,12 @@ class ClientWorker(Worker):
                 # logical process stays single-threaded (core.clj:410-427).
                 self.process += test["concurrency"]
                 try:
-                    self._close_client()
+                    # bare close — no teardown: the DB's shared state must
+                    # survive for the other workers (core.clj:425-427)
+                    self.client.close(test)
                 except Exception:  # noqa: BLE001
                     log.warning("Error closing client", exc_info=True)
+                self.client = None
 
     def teardown(self):
         self._close_client()

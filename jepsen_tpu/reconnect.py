@@ -1,0 +1,173 @@
+"""Auto-reconnecting connection wrappers (reference: jepsen.reconnect,
+reconnect.clj:1-129).
+
+A Wrapper owns a connection plus open/close functions. Many threads may
+use the connection concurrently (read lock); open/close/reopen take the
+write lock. `with_conn()` yields the current connection and, if the body
+throws, closes and reopens it (once, only if it's still the same
+connection that failed) before re-raising."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable
+
+log = logging.getLogger("jepsen_tpu.reconnect")
+
+
+class RWLock:
+    """Write-preferring reader/writer lock (the reference's
+    ReentrantReadWriteLock, reconnect.clj:14,30)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    @contextmanager
+    def read(self):
+        self.acquire_read()
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextmanager
+    def write(self):
+        self.acquire_write()
+        try:
+            yield
+        finally:
+            self.release_write()
+
+
+class Wrapper:
+    """Stateful reconnecting handle to a database connection
+    (reconnect.clj:16-31)."""
+
+    def __init__(
+        self,
+        open: Callable[[], Any],
+        close: Callable[[Any], None],
+        name: str | None = None,
+        log_reconnects: bool = True,
+    ):
+        assert callable(open) and callable(close)
+        self._open = open
+        self._close = close
+        self.name = name
+        self.log_reconnects = log_reconnects
+        self.lock = RWLock()
+        self._conn: Any = None
+
+    def conn(self):
+        """The active connection, if any (reconnect.clj:49-52)."""
+        return self._conn
+
+    def open(self) -> "Wrapper":
+        """Open a connection; no-op if already open
+        (reconnect.clj:54-66)."""
+        with self.lock.write():
+            if self._conn is None:
+                c = self._open()
+                if c is None:
+                    raise RuntimeError(
+                        f"Reconnect wrapper {self.name!r}'s open function "
+                        "returned None instead of a connection!"
+                    )
+                self._conn = c
+        return self
+
+    def close(self) -> "Wrapper":
+        """Close the connection, if open (reconnect.clj:68-75)."""
+        with self.lock.write():
+            if self._conn is not None:
+                self._close(self._conn)
+                self._conn = None
+        return self
+
+    def reopen(self) -> "Wrapper":
+        """Close (if open) and open a fresh connection
+        (reconnect.clj:77-90)."""
+        with self.lock.write():
+            if self._conn is not None:
+                self._close(self._conn)
+                self._conn = None
+            c = self._open()
+            if c is None:
+                raise RuntimeError(
+                    f"Reconnect wrapper {self.name!r}'s open function "
+                    "returned None instead of a connection!"
+                )
+            self._conn = c
+        return self
+
+    @contextmanager
+    def with_conn(self):
+        """Yield the current connection under the read lock; on any
+        exception, reopen the connection (if it's still the one that
+        failed) and re-raise the original error (reconnect.clj:92-129)."""
+        self.lock.acquire_read()
+        c = self._conn
+        try:
+            yield c
+        except Exception:
+            # Trade the read lock for the write lock to reopen
+            self.lock.release_read()
+            try:
+                with self.lock.write():
+                    if self._conn is c:
+                        if self.log_reconnects:
+                            log.warning(
+                                "Encountered error with conn %r; reopening",
+                                self.name,
+                            )
+                        if self._conn is not None:
+                            try:
+                                self._close(self._conn)
+                            finally:
+                                self._conn = None
+                        self._conn = self._open()
+            except Exception:  # noqa: BLE001
+                # Log but don't mask the original transaction error
+                if self.log_reconnects:
+                    log.warning("Error reopening %r", self.name, exc_info=True)
+            finally:
+                self.lock.acquire_read()
+            raise
+        finally:
+            self.lock.release_read()
+
+
+def wrapper(open, close, name=None, log_reconnects=True) -> Wrapper:
+    return Wrapper(open, close, name=name, log_reconnects=log_reconnects)

@@ -35,6 +35,16 @@ class IllegalHistory(Exception):
         self.info = {"msg": msg, **info}
 
 
+_MSG_KEY_MISMATCH = (
+    "These reads did not query for the same keys, and therefore cannot "
+    "be compared."
+)
+_MSG_DISTINCT_VALUES = (
+    "These two read states contain distinct values for the same key; "
+    "this checker assumes only one write occurs per key."
+)
+
+
 def group_for(n: int, k: int) -> range:
     """The key group containing k: [k - k%n, k - k%n + n)
     (long_fork.clj:97-104)."""
@@ -95,11 +105,7 @@ def read_compare(a: dict, b: dict):
     """-1 if a dominates, 0 if equal, 1 if b dominates, None if
     incomparable (long_fork.clj:158-196)."""
     if set(a.keys()) != set(b.keys()):
-        raise IllegalHistory(
-            "These reads did not query for the same keys, and therefore "
-            "cannot be compared.",
-            reads=[a, b],
-        )
+        raise IllegalHistory(_MSG_KEY_MISMATCH, reads=[a, b])
     res = 0
     for k in a:
         va, vb = a[k], b[k]
@@ -114,12 +120,7 @@ def read_compare(a: dict, b: dict):
                 return None
             res = 1
         else:
-            raise IllegalHistory(
-                "These two read states contain distinct values for the same "
-                "key; this checker assumes only one write occurs per key.",
-                key=k,
-                reads=[a, b],
-            )
+            raise IllegalHistory(_MSG_DISTINCT_VALUES, key=k, reads=[a, b])
     return res
 
 
@@ -142,11 +143,7 @@ def find_forks(ops) -> list:
     vals = np.empty((m, len(keys)), dtype=object)
     for i, vm in enumerate(maps):
         if set(vm.keys()) != set(keys):
-            raise IllegalHistory(
-                "These reads did not query for the same keys, and therefore "
-                "cannot be compared.",
-                reads=[maps[0], vm],
-            )
+            raise IllegalHistory(_MSG_KEY_MISMATCH, reads=[maps[0], vm])
         vals[i] = [vm[k] for k in keys]
     nil = np.equal(vals, None)
     for j, k in enumerate(keys):
@@ -154,8 +151,7 @@ def find_forks(ops) -> list:
         if len(set(col.tolist())) > 1:
             rows = np.flatnonzero(~nil[:, j])[:2]
             raise IllegalHistory(
-                "These two read states contain distinct values for the same "
-                "key; this checker assumes only one write occurs per key.",
+                _MSG_DISTINCT_VALUES,
                 key=k,
                 reads=[maps[int(rows[0])], maps[int(rows[-1])]],
             )
