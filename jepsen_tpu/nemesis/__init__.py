@@ -214,6 +214,45 @@ def compose(nemeses: Mapping) -> Compose:
     return Compose(nemeses)
 
 
+def set_time(remote, node, t: float) -> None:
+    """Set a node's clock to POSIX seconds t (nemesis.clj:198-201)."""
+    remote.exec(node, ["date", "+%s", "-s", f"@{int(t)}"], sudo=True)
+
+
+class ClockScrambler(Nemesis):
+    """Randomizes node clocks within a ±dt-second window
+    (nemesis.clj:203-218)."""
+
+    def __init__(self, dt: float):
+        self.dt = dt
+
+    def invoke(self, test, op):
+        import time as _time
+
+        from ..control import on_nodes
+
+        remote = test["remote"]
+        dt = self.dt
+
+        def scramble(t, node):
+            set_time(remote, node,
+                     _time.time() + _random.randrange(2 * dt) - dt)
+
+        return op.with_(value=on_nodes(test, scramble))
+
+    def teardown(self, test):
+        import time as _time
+
+        from ..control import on_nodes
+
+        remote = test["remote"]
+        on_nodes(test, lambda t, node: set_time(remote, node, _time.time()))
+
+
+def clock_scrambler(dt: float) -> ClockScrambler:
+    return ClockScrambler(dt)
+
+
 class NodeStartStopper(Nemesis):
     """On "start", run stop_fn on some targeted nodes (e.g. kill the DB);
     on "stop", run start_fn to revive them (nemesis.clj:220-263).

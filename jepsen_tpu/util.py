@@ -302,6 +302,17 @@ def nemesis_intervals(history, start_fs=("start",), stop_fs=("stop",)) -> list:
     return pairs
 
 
+def random_nonempty_subset(coll, rng=None):
+    """A random non-empty subset of coll (util.clj parity; used by the
+    clock nemesis generators, nemesis/time.clj:137-165)."""
+    import random as _r
+
+    rng = rng or _r
+    items = list(coll)
+    k = rng.randrange(1, len(items) + 1)
+    return rng.sample(items, k)
+
+
 def rand_exp(mean: float, rng=None) -> float:
     """Exponentially-distributed random delay with the given mean
     (util.clj rand-exp; used by generator.stagger)."""

@@ -1,0 +1,219 @@
+"""Clock nemesis + native time tools + faketime tests (reference:
+nemesis/time.clj, resources/bump-time.c, resources/strobe-time.c,
+faketime.clj, nemesis.clj:198-218)."""
+
+import os
+import time
+
+import pytest
+
+from jepsen_tpu import faketime
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import DummyRemote, LocalRemote, Result
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import time as ntime
+
+
+@pytest.fixture
+def local(tmp_path):
+    return LocalRemote(root=str(tmp_path / "nodes"))
+
+
+@pytest.fixture(scope="module")
+def compiled(tmp_path_factory):
+    """Compile both tools once into a module-scoped sandbox node."""
+    root = tmp_path_factory.mktemp("nodes")
+    lr = LocalRemote(root=str(root))
+    ntime.compile_tools(lr, "n1", opt_dir="opt")
+    return lr
+
+
+class TestNativeTools:
+    def test_bump_time_dry_run(self, compiled):
+        before = time.time()
+        out = compiled.exec("n1", ["opt/bump-time", "--dry-run", "5000"]).out
+        t = ntime.parse_time(out)
+        # printed time should be ~5s ahead of now
+        assert 4.0 < t - before < 6.5
+
+    def test_bump_time_negative_delta(self, compiled):
+        before = time.time()
+        out = compiled.exec("n1", ["opt/bump-time", "-n", "-3000"]).out
+        t = ntime.parse_time(out)
+        assert -4.5 < t - before < -1.5
+
+    def test_bump_time_usage(self, compiled):
+        r = compiled.exec("n1", ["opt/bump-time"], check=False)
+        assert r.exit == 1
+        assert "usage" in r.err
+
+    def test_strobe_time_dry_run_counts(self, compiled):
+        out = compiled.exec(
+            "n1", ["opt/strobe-time", "--dry-run", "100", "10", "0.2"]
+        ).out
+        # ~20 adjustments in 0.2s at 10ms period (sleep jitter allowed)
+        assert 5 <= int(out) <= 25
+
+    def test_strobe_time_usage(self, compiled):
+        r = compiled.exec("n1", ["opt/strobe-time", "5"], check=False)
+        assert r.exit == 1
+        assert "usage" in r.err
+
+
+class TestOffsets:
+    def test_current_offset_near_zero(self, local):
+        assert abs(ntime.current_offset(local, "n1")) < 2.0
+
+    def test_parse_time(self):
+        assert ntime.parse_time("123.5\n") == 123.5
+
+
+class _ClockRemote(DummyRemote):
+    """Dummy remote that answers date/bump-time/strobe-time with canned
+    wall-clock strings so ClockNemesis can be driven hermetically."""
+
+    def __init__(self, skew: float = 0.0):
+        super().__init__()
+        self.skew = skew
+
+    def exec(self, node, cmd, **kw):
+        r = super().exec(node, cmd, **kw)
+        if "date +%s.%N" in r.cmd:
+            return Result(f"{time.time() + self.skew:.9f}", "", 0, r.cmd)
+        if "bump-time" in r.cmd:
+            import re
+
+            delta_ms = float(
+                re.search(r"bump-time'? (-?[\d.]+)", r.cmd).group(1)
+            )
+            return Result(
+                f"{time.time() + delta_ms / 1000:.6f}", "", 0, r.cmd
+            )
+        return r
+
+
+class TestClockNemesis:
+    def _test_map(self, remote, nodes=("n1", "n2")):
+        return {"remote": remote, "nodes": list(nodes)}
+
+    def test_check_offsets(self):
+        remote = _ClockRemote(skew=3.0)
+        t = self._test_map(remote)
+        nemesis = ntime.clock_nemesis()
+        op = nemesis.invoke(t, Op("nemesis", "info", "check-offsets"))
+        offs = op.extra["clock_offsets"]
+        assert set(offs) == {"n1", "n2"}
+        assert all(2.0 < v < 4.0 for v in offs.values())
+
+    def test_bump_targets_only_listed_nodes(self):
+        remote = _ClockRemote()
+        t = self._test_map(remote)
+        nemesis = ntime.clock_nemesis()
+        op = nemesis.invoke(
+            t, Op("nemesis", "info", "bump", {"n2": 8000})
+        )
+        offs = op.extra["clock_offsets"]
+        assert set(offs) == {"n2"}
+        assert 7.0 < offs["n2"] < 9.0
+        assert any("bump-time 8000" in c for _, c in remote.commands)
+
+    def test_strobe_command_shape(self):
+        remote = _ClockRemote()
+        t = self._test_map(remote)
+        nemesis = ntime.clock_nemesis()
+        op = nemesis.invoke(
+            t,
+            Op("nemesis", "info", "strobe",
+               {"n1": {"delta": 100, "period": 5, "duration": 2}}),
+        )
+        assert set(op.extra["clock_offsets"]) == {"n1"}
+        assert any("strobe-time 100 5 2" in c for _, c in remote.commands)
+
+    def test_reset(self):
+        remote = _ClockRemote()
+        t = self._test_map(remote)
+        nemesis = ntime.clock_nemesis()
+        op = nemesis.invoke(t, Op("nemesis", "info", "reset", ["n1"]))
+        assert set(op.extra["clock_offsets"]) == {"n1"}
+        assert any("ntpdate" in c for _, c in remote.commands)
+
+    def test_setup_installs_tools(self, local):
+        t = {"remote": local, "nodes": ["n1"]}
+        nemesis = ntime.ClockNemesis(opt_dir="opt")
+        nemesis.setup(t)
+        d = local.node_dir("n1")
+        assert os.path.exists(os.path.join(d, "opt", "bump-time"))
+        assert os.path.exists(os.path.join(d, "opt", "strobe-time"))
+
+
+class TestClockGens:
+    def _t(self):
+        return {"nodes": ["a", "b", "c"], "concurrency": 3}
+
+    def test_reset_gen(self):
+        op = ntime.reset_gen(self._t(), 0)
+        assert op["f"] == "reset"
+        assert set(op["value"]) <= {"a", "b", "c"} and op["value"]
+
+    def test_bump_gen_range(self):
+        for _ in range(20):
+            op = ntime.bump_gen(self._t(), 0)
+            for delta in op["value"].values():
+                assert 4 <= abs(delta) <= 2**18
+
+    def test_strobe_gen_shape(self):
+        op = ntime.strobe_gen(self._t(), 0)
+        for spec in op["value"].values():
+            assert 4 <= spec["delta"] <= 2**18
+            assert 1 <= spec["period"] <= 1024
+            assert 0 <= spec["duration"] <= 32
+
+    def test_clock_gen_starts_with_check(self):
+        g = ntime.clock_gen()
+        t = self._t()
+        with gen.with_threads([gen.NEMESIS]):
+            op = g.op(t, gen.NEMESIS)
+            assert op["f"] == "check-offsets"
+            op2 = g.op(t, gen.NEMESIS)
+            assert op2["f"] in ("reset", "bump", "strobe")
+
+
+class TestClockScrambler:
+    def test_invoke_sets_time_on_all_nodes(self):
+        remote = DummyRemote()
+        t = {"remote": remote, "nodes": ["n1", "n2"]}
+        s = nem.clock_scrambler(60)
+        op = s.invoke(t, Op("nemesis", "info", "scramble"))
+        date_cmds = [c for _, c in remote.commands if "date +%s -s" in c]
+        assert len(date_cmds) == 2
+        assert set(op.value) == {"n1", "n2"}
+
+    def test_teardown_resets(self):
+        remote = DummyRemote()
+        t = {"remote": remote, "nodes": ["n1"]}
+        nem.clock_scrambler(60).teardown(t)
+        assert any("date +%s -s" in c for _, c in remote.commands)
+
+
+class TestFaketime:
+    def test_script_contents(self):
+        s = faketime.script("/opt/db/bin/db", -5, 1.5)
+        assert s.startswith("#!/bin/bash")
+        assert 'faketime -m -f "-5s x1.5"' in s
+        assert '/opt/db/bin/db "$@"' in s
+
+    def test_wrap_moves_and_is_idempotent(self, local):
+        d = local.node_dir("n1")
+        os.makedirs(os.path.join(d, "bin"), exist_ok=True)
+        with open(os.path.join(d, "bin", "db"), "w") as f:
+            f.write("#!/bin/bash\necho real-db\n")
+        faketime.wrap(local, "n1", "bin/db", 10, 2.0)
+        assert os.path.exists(os.path.join(d, "bin", "db.no-faketime"))
+        wrapper = open(os.path.join(d, "bin", "db")).read()
+        assert "faketime" in wrapper and "bin/db.no-faketime" in wrapper
+        # idempotent: wrapping again keeps the original binary
+        faketime.wrap(local, "n1", "bin/db", 20, 0.5)
+        orig = open(os.path.join(d, "bin", "db.no-faketime")).read()
+        assert "real-db" in orig
+        assert 'x0.5"' in open(os.path.join(d, "bin", "db")).read()
