@@ -28,10 +28,14 @@ def wrap(remote: Remote, node, cmd: str, init_offset: float, rate: float
     original at cmd.no-faketime; idempotent (faketime.clj:20-31)."""
     orig = f"{cmd}.no-faketime"
     wrapper = script(orig, init_offset, rate)
+    # DB executables are normally root-owned; these must run as root
+    # like the reference's su context (faketime.clj:20-31).
     if exists(remote, node, orig):
         log.info("Installing faketime wrapper.")
-        remote.exec(node, ["tee", cmd], stdin=wrapper)
+        remote.exec(node, ["tee", cmd], stdin=wrapper, sudo=True)
+        # re-chmod: a prior install may have died before its chmod
+        remote.exec(node, ["chmod", "a+x", cmd], sudo=True)
     else:
-        remote.exec(node, ["mv", cmd, orig])
-        remote.exec(node, ["tee", cmd], stdin=wrapper)
-        remote.exec(node, ["chmod", "a+x", cmd])
+        remote.exec(node, ["mv", cmd, orig], sudo=True)
+        remote.exec(node, ["tee", cmd], stdin=wrapper, sudo=True)
+        remote.exec(node, ["chmod", "a+x", cmd], sudo=True)

@@ -235,8 +235,10 @@ class ClockScrambler(Nemesis):
         dt = self.dt
 
         def scramble(t, node):
+            # uniform over [-dt, dt); randrange would TypeError on a
+            # float dt (the reference's rand-int coerces doubles)
             set_time(remote, node,
-                     _time.time() + _random.randrange(2 * dt) - dt)
+                     _time.time() + _random.uniform(-dt, dt))
 
         return op.with_(value=on_nodes(test, scramble))
 

@@ -18,41 +18,89 @@ log = logging.getLogger("jepsen_tpu.reconnect")
 
 
 class RWLock:
-    """Write-preferring reader/writer lock (the reference's
-    ReentrantReadWriteLock, reconnect.clj:14,30)."""
+    """Write-preferring reentrant reader/writer lock (the reference's
+    ReentrantReadWriteLock, reconnect.clj:14,30).
+
+    Matches java.util.concurrent semantics: a thread may re-acquire the
+    read lock it already holds (nested with_conn works), the writer may
+    take the read lock (downgrade), and write acquisition is reentrant.
+    Read→write *upgrade* is not supported — like the Java lock, a
+    reader calling acquire_write deadlocks — so open()/close()/reopen()
+    must not be called from inside a with_conn body."""
 
     def __init__(self):
         self._cond = threading.Condition()
-        self._readers = 0
-        self._writer = False
+        self._read_holds: dict[int, int] = {}  # thread id -> hold count
+        self._writer: int | None = None  # owning thread id
+        self._write_holds = 0
         self._writers_waiting = 0
 
     def acquire_read(self):
+        me = threading.get_ident()
         with self._cond:
-            while self._writer or self._writers_waiting:
+            if self._read_holds.get(me) or self._writer == me:
+                self._read_holds[me] = self._read_holds.get(me, 0) + 1
+                return
+            while self._writer is not None or self._writers_waiting:
                 self._cond.wait()
-            self._readers += 1
+            self._read_holds[me] = 1
 
     def release_read(self):
+        me = threading.get_ident()
         with self._cond:
-            self._readers -= 1
-            if self._readers == 0:
-                self._cond.notify_all()
+            n = self._read_holds.get(me, 0) - 1
+            if n > 0:
+                self._read_holds[me] = n
+            else:
+                self._read_holds.pop(me, None)
+                if not self._read_holds:
+                    self._cond.notify_all()
 
     def acquire_write(self):
+        me = threading.get_ident()
         with self._cond:
+            if self._writer == me:
+                self._write_holds += 1
+                return
             self._writers_waiting += 1
             try:
-                while self._writer or self._readers:
+                while self._writer is not None or self._read_holds:
                     self._cond.wait()
             finally:
                 self._writers_waiting -= 1
-            self._writer = True
+            self._writer = me
+            self._write_holds = 1
 
     def release_write(self):
         with self._cond:
-            self._writer = False
-            self._cond.notify_all()
+            self._write_holds -= 1
+            if self._write_holds == 0:
+                self._writer = None
+                self._cond.notify_all()
+
+    def release_all_reads(self) -> int:
+        """Drop every read hold this thread has; returns the count so it
+        can be restored with reacquire_reads. Used by with_conn's
+        error path so a nested body can still trade up to the write
+        lock without deadlocking on its own outer holds."""
+        me = threading.get_ident()
+        with self._cond:
+            n = self._read_holds.pop(me, 0)
+            if n and not self._read_holds:
+                self._cond.notify_all()
+            return n
+
+    def reacquire_reads(self, n: int):
+        if n <= 0:
+            return
+        me = threading.get_ident()
+        with self._cond:
+            if self._read_holds.get(me) or self._writer == me:
+                self._read_holds[me] = self._read_holds.get(me, 0) + n
+                return
+            while self._writer is not None or self._writers_waiting:
+                self._cond.wait()
+            self._read_holds[me] = n
 
     @contextmanager
     def read(self):
@@ -142,8 +190,10 @@ class Wrapper:
         try:
             yield c
         except Exception:
-            # Trade the read lock for the write lock to reopen
-            self.lock.release_read()
+            # Trade the read lock for the write lock to reopen. Release
+            # ALL of this thread's read holds (we may be nested) so the
+            # write acquisition can't deadlock on our own outer holds.
+            held = self.lock.release_all_reads()
             try:
                 with self.lock.write():
                     if self._conn is c:
@@ -157,13 +207,20 @@ class Wrapper:
                                 self._close(self._conn)
                             finally:
                                 self._conn = None
-                        self._conn = self._open()
+                        c2 = self._open()
+                        if c2 is None:
+                            raise RuntimeError(
+                                f"Reconnect wrapper {self.name!r}'s open "
+                                "function returned None instead of a "
+                                "connection!"
+                            )
+                        self._conn = c2
             except Exception:  # noqa: BLE001
                 # Log but don't mask the original transaction error
                 if self.log_reconnects:
                     log.warning("Error reopening %r", self.name, exc_info=True)
             finally:
-                self.lock.acquire_read()
+                self.lock.reacquire_reads(held)
             raise
         finally:
             self.lock.release_read()

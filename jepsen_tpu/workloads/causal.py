@@ -15,23 +15,7 @@ from .. import generator as gen
 from .. import independent
 from ..checker import Checker
 from ..history import ops as _ops
-
-
-class Inconsistent:
-    """Invalid model termination (causal.clj:15-31)."""
-
-    def __init__(self, msg: str):
-        self.msg = msg
-
-    def step(self, op):
-        return self
-
-    def __str__(self) -> str:
-        return self.msg
-
-
-def inconsistent(model) -> bool:
-    return isinstance(model, Inconsistent)
+from ..models import Inconsistent, inconsistent
 
 
 class CausalRegister:
@@ -59,7 +43,10 @@ class CausalRegister:
                 f"expected value {c} attempting to write {v} instead"
             )
         if op.f == "read-init":
-            if self.counter == 0 and v not in (None, 0):
+            # On a fresh register the init read must be exactly 0 —
+            # the reference's (and (= 0 counter) (not= 0 v')) also
+            # rejects nil (causal.clj:56-60).
+            if self.counter == 0 and v != 0:
                 return Inconsistent(f"expected init value 0, read {v}")
             if v is None or v == self.value:
                 return CausalRegister(self.value, self.counter, pos)

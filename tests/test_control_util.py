@@ -254,6 +254,62 @@ class TestReconnect:
         [t.join(timeout=5) for t in ts]
         assert not any(t.is_alive() for t in ts)
 
+    def test_nested_with_conn_is_reentrant(self):
+        """A thread may nest with_conn (ReentrantReadWriteLock parity,
+        reconnect.clj:14) without deadlocking itself."""
+        w, _, _ = self._wrapper()
+        w.open()
+        done = []
+
+        def nester():
+            with w.with_conn() as c1:
+                with w.with_conn() as c2:
+                    assert c1 is c2
+                    done.append(1)
+
+        t = threading.Thread(target=nester)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive() and done == [1]
+
+    def test_nested_with_conn_inner_failure_reopens(self):
+        w, opened, closed = self._wrapper()
+        w.open()
+        c1 = w.conn()
+        done = []
+
+        def nester():
+            try:
+                with w.with_conn():
+                    with w.with_conn():
+                        raise ValueError("inner")
+            except ValueError:
+                done.append(1)
+
+        t = threading.Thread(target=nester)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive() and done == [1]
+        assert closed == [c1] and len(opened) == 2
+
+    def test_rwlock_write_reentrant_and_downgrade(self):
+        lk = reconnect.RWLock()
+        with lk.write():
+            with lk.write():  # reentrant write
+                with lk.read():  # downgrade: writer may read
+                    pass
+        # lock fully released: another thread can write
+        ok = []
+
+        def writer():
+            with lk.write():
+                ok.append(1)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join(timeout=5)
+        assert ok == [1]
+
     def test_only_failed_conn_reopened_once(self):
         """Two threads failing on the SAME conn trigger one reopen."""
         w, opened, closed = self._wrapper()

@@ -261,6 +261,23 @@ class TestCausal:
         r = causal.check().check({"model": causal.causal_register()}, ops)
         assert r["valid"] is False
 
+    def test_read_init_none_on_fresh_is_inconsistent(self):
+        # causal.clj:56-60 — (not= 0 nil) is true, so a nil init read
+        # on a fresh register must be flagged.
+        ops = [self._op("read-init", None, 1, "init")]
+        r = causal.check().check({"model": causal.causal_register()}, ops)
+        assert r["valid"] is False
+        assert "expected init value 0" in r["error"]
+
+    def test_inconsistent_is_shared_type(self):
+        # The causal model must use the framework-wide Inconsistent so
+        # checkers comparing inconsistency types agree (VERDICT weak #8).
+        from jepsen_tpu import models
+
+        m = causal.causal_register().step(
+            self._op("write", 5, 1, "init"))
+        assert models.inconsistent(m)
+
     def test_bundle(self):
         t = causal.test({"time_limit": 1})
         assert isinstance(t["generator"], gen.Generator)
