@@ -189,10 +189,15 @@ class LocalRemote(Remote):
 
     def _abs(self, node, path) -> str:
         path = str(path)
+        nd = self.node_dir(node)
         if os.path.isabs(path):
-            # Confine "absolute" node paths inside the sandbox
-            return os.path.join(self.node_dir(node), path.lstrip("/"))
-        return os.path.join(self.node_dir(node), path)
+            # Paths already inside the sandbox pass through (tests hand
+            # DBs absolute sandbox dirs); anything else is confined.
+            ap = os.path.abspath(path)
+            if ap == nd or ap.startswith(nd + os.sep):
+                return ap
+            return os.path.join(nd, path.lstrip("/"))
+        return os.path.join(nd, path)
 
 
 class SshRemote(Remote):

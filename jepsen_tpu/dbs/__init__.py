@@ -1,0 +1,14 @@
+"""Per-database test suites (reference: the 26 per-DB projects, SURVEY.md
+§1 L9 / §2.1).
+
+Each suite module supplies, like its reference counterpart:
+  - a DB implementation (install/start/teardown through the control plane)
+  - a Client with the suite's exception-determinacy taxonomy
+  - op generators and a `*_test(opts)` test-map constructor
+  - a `main()` built from cli.single_test_cmd + cli.serve_cmd
+
+Suites here run against real clusters over SSH, and hermetically against
+an in-repo protocol simulator through the same code paths (install
+archive → daemon → wire protocol), so the whole stack is CI-testable
+without network access (SURVEY.md §4.2).
+"""

@@ -1,0 +1,84 @@
+"""Shared machinery for the hermetic protocol simulators (etcd_sim,
+zk_sim): the flock-guarded JSON state store that makes a multi-process
+simulated cluster linearizable by construction, and the tarball builder
+that packages a simulator as an installable "database binary" for the
+suites' normal install_archive path."""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import shlex
+import sys
+import tempfile
+
+
+class Store:
+    """Shared, flock-serialized JSON state."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.lock_path = path + ".lock"
+        # Touch the lock file so flock always has a target.
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        open(self.lock_path, "a").close()
+
+    def _load(self) -> dict:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def _save(self, data: dict) -> None:
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(self.path)) or "."
+        )
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.path)
+
+    def transact(self, fn):
+        """Run fn(state-dict) -> (result, new-state|None) under the
+        exclusive lock; None keeps the state unchanged."""
+        with open(self.lock_path, "a") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                data = self._load()
+                result, new = fn(data)
+                if new is not None:
+                    self._save(new)
+                return result
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
+def build_sim_archive(dest: str, module: str, binary: str, arcname: str,
+                      data_path: str, mean_latency: float = 0.0,
+                      python: str | None = None) -> str:
+    """Build a tar.gz whose `binary` is a script launching `module`
+    (a jepsen_tpu.dbs simulator) with a shared state file."""
+    import tarfile
+
+    python = python or sys.executable
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = (
+        "#!/bin/bash\n"
+        f"export PYTHONPATH={shlex.quote(repo_root)}:$PYTHONPATH\n"
+        f"exec {shlex.quote(python)} -m {module} "
+        f"--data {shlex.quote(data_path)} --mean-latency {mean_latency} "
+        "\"$@\"\n"
+    )
+    os.makedirs(os.path.dirname(os.path.abspath(dest)) or ".", exist_ok=True)
+    with tempfile.TemporaryDirectory() as td:
+        top = os.path.join(td, arcname)
+        os.makedirs(top)
+        bin_path = os.path.join(top, binary)
+        with open(bin_path, "w") as f:
+            f.write(script)
+        os.chmod(bin_path, 0o755)
+        with tarfile.open(dest, "w:gz") as tar:
+            tar.add(top, arcname=arcname)
+    return dest

@@ -4,9 +4,17 @@ history generators used to cross-check the WGL search."""
 from __future__ import annotations
 
 import random
+import socket
 
 from jepsen_tpu.history import Entries, entries as make_entries
 from jepsen_tpu.models import inconsistent
+
+
+def free_port() -> int:
+    """An ephemeral localhost TCP port for simulator daemons."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def brute_linearizable(model, history) -> bool:

@@ -1,0 +1,236 @@
+"""etcd suite tests: simulator API, client determinacy taxonomy, the DB
+lifecycle through LocalRemote, and a full engine run against a simulated
+3-node cluster (reference behavior: etcd/src/jepsen/etcd.clj)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import core, generator as gen, independent, models, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import etcd, etcd_sim
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+@pytest.fixture
+def sim(tmp_path):
+    """An in-process simulator on an ephemeral port."""
+
+    class H(etcd_sim.Handler):
+        store = etcd_sim.Store(str(tmp_path / "state.json"))
+        mean_latency = 0.0
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+class TestSimAndConn:
+    def test_get_missing_is_none(self, sim):
+        conn = etcd.EtcdHTTP(sim)
+        assert conn.get("nope") is None
+
+    def test_put_get_roundtrip(self, sim):
+        conn = etcd.EtcdHTTP(sim)
+        conn.put("k", 3)
+        assert conn.get("k") == "3"
+
+    def test_cas_success_and_failure(self, sim):
+        conn = etcd.EtcdHTTP(sim)
+        conn.put("k", 1)
+        assert conn.cas("k", 1, 2) is True
+        assert conn.get("k") == "2"
+        assert conn.cas("k", 1, 3) is False
+        assert conn.get("k") == "2"
+
+    def test_cas_missing_key_raises_100(self, sim):
+        conn = etcd.EtcdHTTP(sim)
+        with pytest.raises(etcd.EtcdError) as ei:
+            conn.cas("ghost", 1, 2)
+        assert ei.value.code == 100
+
+    def test_version_endpoint(self, sim):
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(sim + "/version", timeout=2) as r:
+            assert json.load(r)["etcdserver"]
+
+
+class TestClientTaxonomy:
+    """etcd.clj:103,120-136 — reads may :fail, writes/cas must :info."""
+
+    def _client(self, base_url, timeout=5.0):
+        c = etcd.EtcdClient(timeout=timeout)
+        test = {"etcd": {"addr_fn": lambda n: "127.0.0.1",
+                         "client_ports": {"n1": int(base_url.rsplit(":", 1)[1])}}}
+        return c.open(test, "n1"), test
+
+    def _inv(self, f, value):
+        return Op(process=0, type="invoke", f=f, value=value)
+
+    def test_read_write_cas_ok(self, sim):
+        c, _ = self._client(sim)
+        k = 7
+        r0 = c.invoke({}, self._inv("read", independent.tuple_(k, None)))
+        assert r0.type == "ok" and r0.value == independent.tuple_(k, None)
+        w = c.invoke({}, self._inv("write", independent.tuple_(k, 4)))
+        assert w.type == "ok"
+        r1 = c.invoke({}, self._inv("read", independent.tuple_(k, None)))
+        assert r1.type == "ok" and r1.value == independent.tuple_(k, 4)
+        cas_ok = c.invoke({}, self._inv("cas", independent.tuple_(k, (4, 1))))
+        assert cas_ok.type == "ok"
+        cas_bad = c.invoke({}, self._inv("cas", independent.tuple_(k, (9, 2))))
+        assert cas_bad.type == "fail"
+
+    def test_cas_on_missing_key_fails_definitely(self, sim):
+        c, _ = self._client(sim)
+        r = c.invoke({}, self._inv("cas", independent.tuple_(99, (1, 2))))
+        assert r.type == "fail" and r.error == "not-found"
+
+    def test_connection_refused_read_fails_write_crashes(self):
+        dead = f"http://127.0.0.1:{free_port()}"
+        c, _ = self._client(dead, timeout=0.5)
+        r = c.invoke({}, self._inv("read", independent.tuple_(0, None)))
+        assert r.type == "fail"
+        w = c.invoke({}, self._inv("write", independent.tuple_(0, 1)))
+        assert w.type == "info"
+        x = c.invoke({}, self._inv("cas", independent.tuple_(0, (1, 2))))
+        assert x.type == "info"
+
+    def test_timeout_write_crashes(self, tmp_path):
+        # A listening socket that never answers -> socket timeout.
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        try:
+            port = srv.getsockname()[1]
+            c, _ = self._client(f"http://127.0.0.1:{port}", timeout=0.3)
+            w = c.invoke({}, self._inv("write", independent.tuple_(0, 1)))
+            assert w.type == "info" and w.error == "timeout"
+            r = c.invoke({}, self._inv("read", independent.tuple_(0, None)))
+            assert r.type == "fail" and r.error == "timeout"
+        finally:
+            srv.close()
+
+
+def _sim_cluster_cfg(tmp_path, nodes):
+    """Shared config for a LocalRemote simulated cluster."""
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "etcd-sim.tar.gz")
+    etcd_sim.build_archive(archive, str(tmp_path / "shared" / "state.json"))
+    ports = {n: free_port() for n in nodes}
+    cfg = {
+        "addr_fn": lambda n: "127.0.0.1",
+        "client_ports": ports,
+        "peer_ports": {n: free_port() for n in nodes},
+        "dir": lambda n: os.path.join(remote.node_dir(n), "opt", "etcd"),
+        "sudo": None,
+    }
+    return remote, archive, cfg
+
+
+class TestDBLifecycle:
+    def test_setup_teardown_cycle(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = _sim_cluster_cfg(tmp_path, nodes)
+        database = etcd.EtcdDB(version="sim", url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "etcd": cfg,
+                "db": database}
+        try:
+            for n in nodes:
+                database.setup(test, n)
+            # Both members answer and share state through the cluster.
+            c1 = etcd.EtcdHTTP(etcd.client_url(test, "n1"))
+            c2 = etcd.EtcdHTTP(etcd.client_url(test, "n2"))
+            c1.put("x", 5)
+            assert c2.get("x") == "5"
+            # Log files exist where log_files says.
+            for n in nodes:
+                (path,) = database.log_files(test, n)
+                assert os.path.exists(path)
+        finally:
+            for n in nodes:
+                database.teardown(test, n)
+        # Daemons are gone: connection refused.
+        with pytest.raises(Exception):
+            etcd.EtcdHTTP(etcd.client_url(test, "n1"), timeout=0.5).get("x")
+
+
+class TestFullRun:
+    def test_engine_run_against_sim_cluster(self, tmp_path):
+        import itertools
+
+        nodes = ["n1", "n2", "n3"]
+        remote, archive, cfg = _sim_cluster_cfg(tmp_path, nodes)
+        test = {
+            "name": "etcd-sim",
+            "nodes": nodes,
+            "remote": remote,
+            "etcd": cfg,
+            "db": etcd.EtcdDB(version="sim", url=f"file://{archive}"),
+            "client": etcd.EtcdClient(timeout=2.0),
+            "nemesis": nemesis.noop,
+            "os": None,
+            "net": None,
+            "concurrency": 6,
+            "model": models.CASRegister(),
+            "checker": independent.checker(checker_mod.linearizable()),
+            "generator": gen.time_limit(
+                8,
+                gen.clients(
+                    independent.concurrent_generator(
+                        3,
+                        itertools.count(),
+                        lambda k: gen.limit(
+                            30,
+                            gen.stagger(
+                                0.005, gen.mix([etcd.r, etcd.w, etcd.cas])
+                            ),
+                        ),
+                    )
+                ),
+            ),
+        }
+        t0 = time.monotonic()
+        result = core.run(test)
+        assert time.monotonic() - t0 < 60
+        res = result["results"]
+        assert res["valid"] is True, res
+        hist = result["history"]
+        assert len(hist) > 40
+        # ok completions for all three fs made it into the history
+        fs = {o.f for o in hist if o.type == "ok"}
+        assert {"read", "write", "cas"} <= fs
+
+
+class TestBundleAndCli:
+    def test_etcd_test_bundle(self):
+        t = etcd.etcd_test({"time_limit": 5, "nodes": ["a", "b"]})
+        assert t["name"] == "etcd"
+        assert isinstance(t["db"], etcd.EtcdDB)
+        assert isinstance(t["client"], etcd.EtcdClient)
+        assert isinstance(t["generator"], gen.Generator)
+        assert t["nodes"] == ["a", "b"]
+        assert etcd.initial_cluster(t) == (
+            "a=http://a:2380,b=http://b:2380"
+        )
+
+    def test_cli_rejects_bad_args(self, capsys):
+        from jepsen_tpu import cli as cli_mod
+
+        rc = cli_mod.run_cli(
+            {**cli_mod.single_test_cmd(etcd.etcd_test)},
+            ["test", "--concurrency", "wat"],
+        )
+        assert rc == 254

@@ -1,0 +1,283 @@
+"""ZooKeeper suite tests: jute protocol round-trip against the sim,
+client determinacy taxonomy, DB lifecycle (packaged command stream +
+archive mode), and a full engine run on a simulated ensemble
+(reference behavior: zookeeper/src/jepsen/zookeeper.clj)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import core, generator as gen, models, nemesis
+from jepsen_tpu.control import DummyRemote, LocalRemote
+from jepsen_tpu.dbs import zk_proto, zk_sim, zookeeper
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+@pytest.fixture
+def sim(tmp_path):
+    """In-process jute simulator on an ephemeral port."""
+
+    class H(zk_sim.Handler):
+        store = zk_sim.Store(str(tmp_path / "zk-state.json"))
+        mean_latency = 0.0
+
+    srv = zk_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestProtocol:
+    def test_connect_handshake(self, sim):
+        conn = zk_proto.ZkConn("127.0.0.1", sim)
+        assert conn.negotiated_timeout > 0
+        conn.close()
+
+    def test_create_get_set_roundtrip(self, sim):
+        conn = zk_proto.ZkConn("127.0.0.1", sim)
+        conn.create("/r", b"0")
+        data, stat = conn.get_data("/r")
+        assert data == b"0" and stat["version"] == 0
+        stat2 = conn.set_data("/r", b"5", -1)
+        assert stat2["version"] == 1
+        data, _ = conn.get_data("/r")
+        assert data == b"5"
+        conn.close()
+
+    def test_create_existing_raises(self, sim):
+        conn = zk_proto.ZkConn("127.0.0.1", sim)
+        conn.create("/dup", b"x")
+        with pytest.raises(zk_proto.NodeExists):
+            conn.create("/dup", b"y")
+        conn.close()
+
+    def test_get_missing_raises_no_node(self, sim):
+        conn = zk_proto.ZkConn("127.0.0.1", sim)
+        with pytest.raises(zk_proto.NoNode):
+            conn.get_data("/ghost")
+        conn.close()
+
+    def test_version_cas(self, sim):
+        conn = zk_proto.ZkConn("127.0.0.1", sim)
+        conn.create("/c", b"1")
+        _, stat = conn.get_data("/c")
+        conn.set_data("/c", b"2", stat["version"])
+        with pytest.raises(zk_proto.BadVersion):
+            conn.set_data("/c", b"3", stat["version"])  # stale version
+        data, _ = conn.get_data("/c")
+        assert data == b"2"
+        conn.close()
+
+    def test_exists_and_delete(self, sim):
+        conn = zk_proto.ZkConn("127.0.0.1", sim)
+        assert conn.exists("/e") is None
+        conn.create("/e", b"x")
+        assert conn.exists("/e")["version"] == 0
+        conn.delete("/e")
+        assert conn.exists("/e") is None
+        conn.close()
+
+    def test_ping(self, sim):
+        conn = zk_proto.ZkConn("127.0.0.1", sim)
+        conn.ping()
+        conn.close()
+
+    def test_ruok(self, sim):
+        assert zookeeper.ruok(
+            {"zk": {"addr_fn": lambda n: "127.0.0.1",
+                    "client_ports": {"n1": sim}}}, "n1")
+
+    def test_shared_state_across_connections(self, sim):
+        c1 = zk_proto.ZkConn("127.0.0.1", sim)
+        c2 = zk_proto.ZkConn("127.0.0.1", sim)
+        c1.create("/s", b"7")
+        data, _ = c2.get_data("/s")
+        assert data == b"7"
+        c1.close()
+        c2.close()
+
+
+class TestClient:
+    def _test_map(self, port):
+        return {"zk": {"addr_fn": lambda n: "127.0.0.1",
+                       "client_ports": {"n1": port}}}
+
+    def _inv(self, f, value=None):
+        return Op(process=0, type="invoke", f=f, value=value)
+
+    def test_read_write_cas(self, sim):
+        t = self._test_map(sim)
+        c = zookeeper.ZkAtomClient().open(t, "n1")
+        c.setup(t)
+        assert c.invoke(t, self._inv("read")).value == 0
+        assert c.invoke(t, self._inv("write", 3)).type == "ok"
+        assert c.invoke(t, self._inv("read")).value == 3
+        assert c.invoke(t, self._inv("cas", (3, 4))).type == "ok"
+        assert c.invoke(t, self._inv("cas", (9, 1))).type == "fail"
+        assert c.invoke(t, self._inv("read")).value == 4
+        c.close(t)
+
+    def test_setup_idempotent(self, sim):
+        t = self._test_map(sim)
+        c1 = zookeeper.ZkAtomClient().open(t, "n1")
+        c1.setup(t)
+        c2 = zookeeper.ZkAtomClient().open(t, "n1")
+        c2.setup(t)  # NodeExists swallowed
+        c1.close(t)
+        c2.close(t)
+
+    def test_all_ops_info_on_dead_server(self):
+        port = free_port()
+        t = self._test_map(port)
+        cl = zookeeper.ZkAtomClient(timeout=0.5)
+        with pytest.raises(OSError):
+            cl.open(t, "n1")  # the reference's open also throws; worker
+            # records :info and reincarnates
+
+    def test_timeout_is_info(self, sim):
+        # Freeze the sim mid-conversation by connecting to a socket
+        # that accepts but never answers requests after handshake.
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        done = []
+
+        def fake_zk():
+            s, _ = srv.accept()
+            # Answer the handshake, then go silent.
+            buf = zk_proto._recv_exact(s, 4)
+            import struct
+
+            (n,) = struct.unpack(">i", buf)
+            zk_proto._recv_exact(s, n)
+            resp = (zk_proto.Writer().int32(0).int32(10000).int64(1)
+                    .buffer(b"\x00" * 16))
+            zk_proto.write_frame(s, resp.bytes_())
+            done.append(s)  # keep alive
+
+        threading.Thread(target=fake_zk, daemon=True).start()
+        port = srv.getsockname()[1]
+        t = self._test_map(port)
+        c = zookeeper.ZkAtomClient(timeout=0.4).open(t, "n1")
+        r = c.invoke(t, self._inv("read"))
+        assert r.type == "info" and r.error == "timeout"
+        srv.close()
+
+
+class TestDB:
+    def test_packaged_setup_command_stream(self):
+        remote = DummyRemote()
+        test = {"remote": remote, "nodes": ["n1", "n2", "n3"]}
+        database = zookeeper.ZookeeperDB(ready_timeout=0)
+        try:
+            database.setup(test, "n2")
+        except Exception:
+            pass  # ruok can't succeed on a DummyRemote
+        cmds = " ;; ".join(c for _, c in remote.commands)
+        assert "apt-get install" in cmds
+        assert "echo 1 > /etc/zookeeper/conf/myid" in cmds
+        assert "tee /etc/zookeeper/conf/zoo.cfg" in cmds
+        assert "service zookeeper restart" in cmds
+        database.teardown(test, "n2")
+        cmds = " ;; ".join(c for _, c in remote.commands)
+        assert "service zookeeper stop" in cmds
+
+    def test_zoo_cfg_servers(self):
+        test = {"nodes": ["a", "b"]}
+        assert zookeeper.zoo_cfg_servers(test) == (
+            "server.0=a:2888:3888\nserver.1=b:2888:3888"
+        )
+
+    def test_archive_lifecycle(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "zk-sim.tar.gz")
+        zk_sim.build_archive(archive, str(tmp_path / "shared" / "zk.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "client_ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt", "zk"),
+            "sudo": None,
+        }
+        test = {"remote": remote, "nodes": nodes, "zk": cfg}
+        database = zookeeper.ZookeeperDB(archive_url=f"file://{archive}")
+        try:
+            for n in nodes:
+                database.setup(test, n)
+            # ensemble shares state
+            c1 = zk_proto.ZkConn("127.0.0.1", cfg["client_ports"]["n1"])
+            c2 = zk_proto.ZkConn("127.0.0.1", cfg["client_ports"]["n2"])
+            c1.create("/x", b"9")
+            data, _ = c2.get_data("/x")
+            assert data == b"9"
+            c1.close()
+            c2.close()
+        finally:
+            for n in nodes:
+                database.teardown(test, n)
+        assert not zookeeper.ruok(test, "n1")
+
+
+class TestFullRun:
+    def test_engine_run_against_sim_ensemble(self, tmp_path):
+        nodes = ["n1", "n2", "n3"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "zk-sim.tar.gz")
+        zk_sim.build_archive(archive, str(tmp_path / "shared" / "zk.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "client_ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt", "zk"),
+            "sudo": None,
+        }
+        test = {
+            "name": "zookeeper-sim",
+            "nodes": nodes,
+            "remote": remote,
+            "zk": cfg,
+            "db": zookeeper.ZookeeperDB(archive_url=f"file://{archive}"),
+            "client": zookeeper.ZkAtomClient(timeout=2.0),
+            "nemesis": nemesis.noop,
+            "os": None,
+            "net": None,
+            "concurrency": 5,
+            "model": models.CASRegister(0),
+            "checker": checker_mod.linearizable(),
+            "generator": gen.time_limit(
+                6,
+                gen.clients(
+                    gen.stagger(
+                        0.01,
+                        gen.mix([zookeeper.r, zookeeper.w, zookeeper.cas]),
+                    )
+                ),
+            ),
+        }
+        t0 = time.monotonic()
+        result = core.run(test)
+        assert time.monotonic() - t0 < 60
+        res = result["results"]
+        assert res["valid"] is True, res
+        hist = result["history"]
+        oks = [o for o in hist if o.type == "ok"]
+        assert len(oks) > 20
+        assert {"read", "write", "cas"} <= {o.f for o in oks}
+
+
+class TestBundle:
+    def test_zk_test_bundle(self):
+        t = zookeeper.zk_test({"time_limit": 5, "nodes": ["a", "b", "c"]})
+        assert t["name"] == "zookeeper"
+        assert isinstance(t["db"], zookeeper.ZookeeperDB)
+        assert isinstance(t["client"], zookeeper.ZkAtomClient)
+        assert isinstance(t["generator"], gen.Generator)
+        assert t["model"] == models.CASRegister(0)
