@@ -189,7 +189,7 @@ class LocalRemote(Remote):
 
     def _abs(self, node, path) -> str:
         path = str(path)
-        nd = self.node_dir(node)
+        nd = os.path.abspath(self.node_dir(node))
         if os.path.isabs(path):
             # Paths already inside the sandbox pass through (tests hand
             # DBs absolute sandbox dirs); anything else is confined.

@@ -278,12 +278,16 @@ class TestReviewRegressions:
         )
         c = linearizable(UnorderedQueue(), algorithm="competition")
         c.time_limit = None
-        # tpu-ineligible model + forced-unknown host verdict: must return
+        # tpu-ineligible model + BOTH entrants (linear, wgl-host) forced
+        # unknown: the race must still return, with an unknown verdict
+        import jepsen_tpu.ops.linear as ln
         import jepsen_tpu.ops.wgl_host as wh
-        orig = wh.analysis
+        orig_w, orig_l = wh.analysis, ln.analysis
         try:
             wh.analysis = lambda *a, **k: wh.WGLResult(valid="unknown")
+            ln.analysis = lambda *a, **k: ln.LinearResult(valid="unknown")
             r = c.check({}, hist, {})
             assert r["valid"] == "unknown"
         finally:
-            wh.analysis = orig
+            wh.analysis = orig_w
+            ln.analysis = orig_l

@@ -1,0 +1,238 @@
+"""Just-in-time linearization (ops/linear.py, knossos.linear analog):
+literal histories with exact verdicts, randomized cross-checks against
+the brute-force oracle AND the WGL host search, crash semantics, budget
+exhaustion, and the two-algorithm competition checker."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu.history import index, invoke_op, ok_op, fail_op, info_op
+from jepsen_tpu.models import CASRegister, Mutex, Register, UnorderedQueue
+from jepsen_tpu.ops import linear, wgl_host
+
+from helpers import brute_linearizable, random_register_history
+
+
+def h(*ops):
+    return index(list(ops))
+
+
+def valid(model, hist, **kw):
+    return linear.analysis(model, hist, **kw).valid
+
+
+class TestBasics:
+    def test_empty(self):
+        assert valid(CASRegister(), []) is True
+
+    def test_sequential_ok(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read"), ok_op(0, "read", 1),
+            invoke_op(0, "cas", (1, 2)), ok_op(0, "cas", (1, 2)),
+            invoke_op(0, "read"), ok_op(0, "read", 2),
+        )
+        assert valid(CASRegister(), hist) is True
+
+    def test_bad_read(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read"), ok_op(0, "read", 2),
+        )
+        r = linear.analysis(CASRegister(), hist)
+        assert r.valid is False
+        assert r.op is not None
+        assert r.op.f == "read"
+        # knossos.linear carries the dying configurations
+        assert r.configs
+
+    def test_concurrent_read_during_write(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "write", 2),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+            ok_op(0, "write", 2),
+        )
+        assert valid(CASRegister(), hist) is True
+
+    def test_stale_read_after_return_invalid(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "write", 2), ok_op(0, "write", 2),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        assert valid(CASRegister(), hist) is False
+
+    def test_failed_op_excluded(self):
+        hist = h(
+            invoke_op(0, "write", 1), fail_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", None),
+        )
+        assert valid(CASRegister(), hist) is True
+
+    def test_mutex(self):
+        hist = h(
+            invoke_op(0, "acquire"), ok_op(0, "acquire"),
+            invoke_op(1, "acquire"),
+            invoke_op(0, "release"), ok_op(0, "release"),
+            ok_op(1, "acquire"),
+        )
+        assert valid(Mutex(), hist) is True
+        hist2 = h(
+            invoke_op(0, "acquire"), ok_op(0, "acquire"),
+            invoke_op(1, "acquire"), ok_op(1, "acquire"),
+        )
+        assert valid(Mutex(), hist2) is False
+
+    def test_queue_model(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(1, "enqueue", 2), ok_op(1, "enqueue", 2),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 2),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        )
+        assert valid(UnorderedQueue(), hist) is True
+        hist2 = h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 9),
+        )
+        assert valid(UnorderedQueue(), hist2) is False
+
+
+class TestCrashSemantics:
+    def test_crashed_write_may_have_happened(self):
+        hist = h(
+            invoke_op(0, "write", 1), info_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        assert valid(CASRegister(), hist) is True
+
+    def test_crashed_write_may_not_have_happened(self):
+        hist = h(
+            invoke_op(0, "write", 1), info_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", None),
+        )
+        assert valid(CASRegister(), hist) is True
+
+    def test_crashed_op_stays_available_forever(self):
+        # The crashed write can linearize arbitrarily late — after
+        # another completed op.
+        hist = h(
+            invoke_op(0, "write", 1), info_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", None),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        assert valid(CASRegister(), hist) is True
+
+    def test_all_crashed_is_valid(self):
+        hist = h(
+            invoke_op(0, "write", 1), info_op(0, "write", 1),
+            invoke_op(1, "write", 2), info_op(1, "write", 2),
+        )
+        assert valid(CASRegister(), hist) is True
+
+
+class TestBudgets:
+    def test_config_budget_exhaustion_is_unknown(self):
+        hist = random_register_history(n_process=6, n_ops=40, seed=3)
+        r = linear.analysis(CASRegister(), hist, max_configs=2)
+        assert r.valid == "unknown"
+
+    def test_time_budget_exhaustion_is_unknown(self):
+        hist = random_register_history(n_process=6, n_ops=60, seed=4)
+        r = linear.analysis(CASRegister(), hist, time_limit=0.0)
+        # with a zero budget the sweep must bail at the first return
+        assert r.valid == "unknown"
+
+    def test_many_crashed_ops_no_recursion_error(self):
+        # Thousands of pending crashed ops must not blow the stack and
+        # must respect budgets inside a single expansion.
+        from jepsen_tpu.history import index, info_op, invoke_op, ok_op
+
+        ops = []
+        for i in range(1200):
+            ops.append(invoke_op(i, "write", i % 5))
+        for i in range(1200):
+            ops.append(info_op(i, "write", i % 5))
+        ops += [invoke_op(2000, "read"), ok_op(2000, "read", 3)]
+        import time as _t
+
+        t0 = _t.monotonic()
+        r = linear.analysis(CASRegister(), index(ops),
+                            time_limit=1.0, max_configs=5000)
+        assert r.valid in (True, "unknown")
+        assert _t.monotonic() - t0 < 20
+
+    def test_steps_and_cache_reported(self):
+        hist = random_register_history(n_process=3, n_ops=12, seed=5)
+        r = linear.analysis(CASRegister(), hist)
+        assert r.steps > 0 and r.cache_size >= 1
+
+
+class TestParity:
+    @pytest.mark.parametrize("seed", range(30))
+    def test_matches_brute_force_small(self, seed):
+        hist = random_register_history(
+            n_process=3, n_ops=10, seed=seed, corrupt=0.3 * (seed % 3 == 0)
+        )
+        expect = brute_linearizable(CASRegister(), hist)
+        got = valid(CASRegister(), hist)
+        assert got == expect, f"seed {seed}: linear {got} != brute {expect}"
+
+    @pytest.mark.parametrize("seed", range(25))
+    def test_matches_wgl_host_larger(self, seed):
+        hist = random_register_history(
+            n_process=5, n_ops=60, seed=100 + seed,
+            corrupt=0.2 * (seed % 2),
+        )
+        want = wgl_host.analysis(CASRegister(), hist).valid
+        got = valid(CASRegister(), hist)
+        assert got == want, f"seed {seed}: linear {got} != wgl {want}"
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_matches_wgl_on_register_model(self, seed):
+        hist = random_register_history(
+            n_process=4, n_ops=30, seed=200 + seed, cas=False,
+            corrupt=0.25 * (seed % 2),
+        )
+        want = wgl_host.analysis(Register(), hist).valid
+        got = valid(Register(), hist)
+        assert got == want
+
+
+class TestCompetition:
+    def test_competition_valid(self):
+        hist = random_register_history(n_process=3, n_ops=20, seed=7)
+        c = checker_mod.linearizable(CASRegister(), algorithm="competition")
+        r = c.check({}, hist, {})
+        assert r["valid"] is True
+
+    def test_competition_invalid(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read"), ok_op(0, "read", 2),
+        )
+        c = checker_mod.linearizable(CASRegister(), algorithm="competition")
+        r = c.check({}, hist, {})
+        assert r["valid"] is False
+
+    def test_competition_on_queue_model_uses_host_wgl(self):
+        # Queue models have no TPU encoding; competition must still
+        # produce a verdict via linear + wgl-host.
+        hist = h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 1),
+        )
+        c = checker_mod.linearizable(UnorderedQueue(),
+                                     algorithm="competition")
+        r = c.check({}, hist, {})
+        assert r["valid"] is True
+
+    def test_linear_algorithm_via_checker(self):
+        hist = random_register_history(n_process=3, n_ops=15, seed=9)
+        c = checker_mod.linearizable(CASRegister(), algorithm="linear")
+        r = c.check({}, hist, {})
+        assert r["valid"] is True
+        assert "steps" in r
