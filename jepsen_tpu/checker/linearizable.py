@@ -61,18 +61,9 @@ def _tpu_eligible(model, es) -> bool:
     jm = mjit.for_model(model)
     if jm is None:
         return False
-    try:
-        for f, v in zip(es.f, es.value_out):
-            if f not in jm.fs:
-                continue  # encoded as never-linearizable, value unused
-            if isinstance(v, (tuple, list)):
-                for x in v:
-                    mjit.encode_value(x)
-            else:
-                mjit.encode_value(v)
-    except (OverflowError, TypeError, ValueError):
-        return False
-    return True
+    # per-model payload check: int32-encodable for scalar models,
+    # hashable for the queue's per-lane slot map (models/jit.py)
+    return jm.lane_eligible(es)
 
 
 class Linearizable(Checker):

@@ -119,7 +119,15 @@ class Mutex(Model):
 
 
 def _freeze_multiset(items) -> tuple:
-    return tuple(sorted(items))
+    """A canonical tuple for a multiset, so ==-equal pending sets compare
+    and hash equal in the search memo. Mixed-type payloads (unorderable)
+    fall back to a type-aware sort key — semantically equal multisets may
+    then freeze differently across type boundaries, which only costs memo
+    pruning, never soundness."""
+    try:
+        return tuple(sorted(items))
+    except TypeError:
+        return tuple(sorted(items, key=lambda x: (type(x).__name__, repr(x))))
 
 
 @dataclass(frozen=True)

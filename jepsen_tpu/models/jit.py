@@ -1,20 +1,32 @@
 """Int-encoded, jit-compilable model step functions.
 
-The TPU linearizability search (ops/wgl.py) can't step Python objects: it
-needs the model as a branchless int32 transition function
-
-    step(state: int32, f: int32, v1: int32, v2: int32) -> (state', ok: bool)
-
+The TPU linearizability search (ops/wgl_tpu.py) can't step Python
+objects: it needs the model as a branchless int32 transition function
 compiled straight into the search kernel (BASELINE.json north star: "the
-knossos.model state-transition function JIT-compiled"). Each `JitModel`
-packs a host model's state into an int32 scalar and mirrors its semantics
-exactly; tests/test_models.py checks equivalence against the host oracle
-in jepsen_tpu.models.
+knossos.model state-transition function JIT-compiled"). Each kernel
+model packs a host model's state into a fixed int32 VECTOR and mirrors
+its semantics exactly; tests/test_models.py checks equivalence against
+the host oracle in jepsen_tpu.models.
+
+Two families:
+
+- Scalar models (register / cas-register / mutex): state is one int32
+  (a width-1 vector in the kernel), values are encoded globally via
+  `encode_value` (ints only), and the memo key is (bitset, state).
+- The unordered-queue model (knossos.model/unordered-queue): state is a
+  COUNT VECTOR over the lane's distinct values — each lane builds its
+  own value -> slot mapping, so any hashable payloads work, not just
+  ints. Two structural facts make it as cheap as the scalar models:
+  the multiset state is a pure function of WHICH ops are linearized
+  (order-independent), so the memo key is the bitset alone
+  (state_in_key=False); and enqueue/dequeue are exactly invertible, so
+  backtracking applies `unstep` instead of storing a state snapshot per
+  DFS depth (has_unstep=True).
 
 Value sentinel: NIL32 marks "unknown/absent" (a crashed read's value, an
-unset register). Payload values must fit in int32 and stay below NIL32 —
-the encoder in ops/wgl.py enforces this and falls back to the host search
-otherwise.
+unset register). Scalar payload values must fit in int32 and stay below
+NIL32 — `lane_eligible` enforces this and the checker falls back to the
+host search otherwise.
 """
 
 from __future__ import annotations
@@ -30,10 +42,14 @@ NIL32 = np.int32(2**30)
 
 @dataclass(frozen=True)
 class JitModel:
-    """A model expressed as an int32 transition function.
+    """A model expressed as an int32 scalar transition function.
 
     fs: f-name -> code mapping used by the encoder (must match the
     workload's FSchema ordering).
+
+    The kernel-facing interface (vec_step / init_vec / encode_entry /
+    lane_*) presents this as a width-1 vector model so the TPU search
+    compiles one uniform kernel shape for all models.
     """
 
     name: str
@@ -41,8 +57,62 @@ class JitModel:
     init_state: int
     step: Callable  # (state, f, v1, v2) -> (state', ok)
 
+    # memo key is (bitset, state); no inverse step (writes destroy state)
+    state_in_key = True
+    has_unstep = False
+
     def f_code(self, f) -> int:
         return self.fs.index(f)
+
+    # ---- kernel interface ----
+
+    def lane_width(self, es) -> int:
+        return 1
+
+    def lane_codec(self, es) -> Callable:
+        return encode_value
+
+    def lane_eligible(self, es) -> bool:
+        """Every payload in `es` has an int32 encoding."""
+        for f, v in zip(es.f, es.value_out):
+            if f not in self.fs:
+                continue  # encoded as never-linearizable, value unused
+            try:
+                if isinstance(v, (tuple, list)):
+                    for x in v:
+                        encode_value(x)
+                else:
+                    encode_value(v)
+            except (OverflowError, TypeError, ValueError):
+                return False
+        return True
+
+    def init_vec(self, width: int) -> np.ndarray:
+        assert width >= 1
+        out = np.zeros(width, np.int32)
+        out[0] = self.init_state
+        return out
+
+    def encode_entry(self, fname, val, codec) -> tuple:
+        """-> (f_code, v1, v2) for one entry. Ops the host model can
+        NEVER linearize (unknown :f, or a cas with unknown arguments ->
+        Inconsistent) encode as f = -1: every step maps -1 to ok=False,
+        the exact kernel image of Inconsistent."""
+        if fname not in self.fs or (fname == "cas" and val is None):
+            return -1, int(NIL32), int(NIL32)
+        if isinstance(val, (tuple, list)):
+            v1 = codec(val[0] if len(val) > 0 else None)
+            v2 = codec(val[1] if len(val) > 1 else None)
+        else:
+            v1, v2 = codec(val), int(NIL32)
+        return self.f_code(fname), v1, v2
+
+    def vec_step(self, state, f, v1, v2):
+        s, ok = self.step(state[0], f, v1, v2)
+        return state.at[0].set(s.astype(jnp.int32)), ok
+
+    def vec_unstep(self, state, f, v1, v2):
+        raise NotImplementedError(f"{self.name} has no inverse step")
 
 
 def _cas_register_step(state, f, v1, v2):
@@ -105,14 +175,95 @@ mutex = JitModel(
 )
 
 
-BY_NAME = {m.name: m for m in (cas_register, register, mutex)}
+@dataclass(frozen=True)
+class QueueJitModel:
+    """knossos.model/unordered-queue as a count-vector kernel model.
+
+    State is int32[width] where slot i counts how many copies of the
+    lane's i-th distinct value are pending. Per-lane value -> slot
+    mapping comes from a dict walk of the history (lane_codec), so any
+    hashable payloads work and cross-type equality (1 == 1.0) matches
+    the host model's `value in pending` semantics exactly.
+
+    state_in_key=False: the multiset is determined by WHICH entries are
+    linearized (each linearized enqueue adds its value, each dequeue
+    removes it — order never matters), so the bitset alone is a complete
+    memo key. has_unstep=True: backtracking an enqueue decrements its
+    slot, a dequeue increments it — no per-depth state snapshots.
+    """
+
+    name: str = "unordered-queue"
+    fs: tuple = ("enqueue", "dequeue")
+
+    state_in_key = False
+    has_unstep = True
+
+    def f_code(self, f) -> int:
+        return self.fs.index(f)
+
+    def _universe(self, es) -> dict:
+        """value -> slot over every enqueue/dequeue payload in the lane
+        (insertion order; dict equality collapses ==-equal values just
+        like the host model's multiset membership test)."""
+        m: dict = {}
+        for f, v in zip(es.f, es.value_out):
+            if f in self.fs and v not in m:
+                m[v] = len(m)
+        return m
+
+    def lane_width(self, es) -> int:
+        return max(1, len(self._universe(es)))
+
+    def lane_codec(self, es) -> Callable:
+        m = self._universe(es)
+        return lambda v: m[v]
+
+    def lane_eligible(self, es) -> bool:
+        """Eligible iff every queue payload is hashable (unhashable
+        values can't index the slot map; the host path handles them)."""
+        try:
+            self._universe(es)
+        except TypeError:
+            return False
+        return True
+
+    def init_vec(self, width: int) -> np.ndarray:
+        return np.zeros(width, np.int32)
+
+    def encode_entry(self, fname, val, codec) -> tuple:
+        if fname not in self.fs:
+            return -1, int(NIL32), int(NIL32)
+        return self.f_code(fname), codec(val), int(NIL32)
+
+    def vec_step(self, state, f, v1, v2):
+        # f: 0=enqueue 1=dequeue; v1 = slot index. f == -1 never ok.
+        is_enq = f == 0
+        is_deq = f == 1
+        slot = jnp.clip(v1, 0, state.shape[0] - 1)
+        ok = jnp.where(is_enq, True, is_deq & (state[slot] > 0))
+        delta = jnp.where(ok & is_enq, 1, 0) - jnp.where(ok & is_deq, 1, 0)
+        return state.at[slot].add(delta.astype(jnp.int32)), ok
+
+    def vec_unstep(self, state, f, v1, v2):
+        # exact inverse of an APPLIED (ok) transition
+        slot = jnp.clip(v1, 0, state.shape[0] - 1)
+        delta = jnp.where(f == 0, -1, 1)
+        return state.at[slot].add(delta.astype(jnp.int32))
 
 
-def for_model(model) -> JitModel | None:
-    """The JitModel equivalent of a host model instance (fresh state only),
-    or None if the model has no scalar int encoding (queues, sets) — the
-    checker then uses the host search path."""
-    from . import CASRegister, Mutex, Register
+unordered_queue = QueueJitModel()
+
+
+BY_NAME = {
+    m.name: m for m in (cas_register, register, mutex, unordered_queue)
+}
+
+
+def for_model(model):
+    """The kernel-model equivalent of a host model instance (fresh state
+    only), or None if the model has no kernel encoding (FIFO queues,
+    sets) — the checker then uses the host search path."""
+    from . import CASRegister, Mutex, Register, UnorderedQueue
 
     if isinstance(model, CASRegister) and model.value is None:
         return cas_register
@@ -120,6 +271,8 @@ def for_model(model) -> JitModel | None:
         return register
     if isinstance(model, Mutex) and not model.locked:
         return mutex
+    if isinstance(model, UnorderedQueue) and not model.pending:
+        return unordered_queue
     return None
 
 

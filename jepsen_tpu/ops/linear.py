@@ -38,7 +38,6 @@ class LinearResult:
     valid: Any  # True | False | "unknown"
     op: Op | None = None  # the op at whose return every config died
     configs: list = field(default_factory=list)  # surviving/last configs
-    final_paths: list | None = None
     cache_size: int = 0  # peak live configuration count
     steps: int = 0  # model.step invocations
     best_linearization: list | None = None  # kept None: not a DFS path

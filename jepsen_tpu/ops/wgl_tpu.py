@@ -59,13 +59,17 @@ def _next_pow2(x: int) -> int:
     return 1 << max(1, math.ceil(math.log2(max(2, x))))
 
 
-def encode_entries(es: Entries, jm: mjit.JitModel, n_pad: int) -> dict:
+def encode_entries(es: Entries, jm, n_pad: int) -> dict:
     """Pack host Entries into fixed-shape int32 arrays for one kernel
     lane. Event node ids: 0 is the head sentinel; event at position p is
-    node p+1. Padded entries simply never appear in the linked list."""
+    node p+1. Padded entries simply never appear in the linked list.
+    Value encoding is delegated to the kernel model: scalar models use
+    the global int32 codec, the queue model a per-lane value->slot map
+    (models/jit.py)."""
     n = len(es)
     assert n <= n_pad
     m = 2 * n_pad + 1
+    codec = jm.lane_codec(es)
     f = np.zeros(n_pad, np.int32)
     v1 = np.full(n_pad, mjit.NIL32, np.int32)
     v2 = np.full(n_pad, mjit.NIL32, np.int32)
@@ -75,21 +79,7 @@ def encode_entries(es: Entries, jm: mjit.JitModel, n_pad: int) -> dict:
     node_entry = np.zeros(m, np.int32)
     node_is_call = np.zeros(m, bool)
     for e in range(n):
-        val = es.value_out[e]
-        fname = es.f[e]
-        # Ops the host model can NEVER linearize (unknown :f, or a cas
-        # with unknown arguments -> Inconsistent) encode as f = -1: every
-        # JitModel step maps -1 to ok=False, the exact kernel image of
-        # Inconsistent.
-        if fname not in jm.fs or (fname == "cas" and val is None):
-            f[e] = -1
-        else:
-            f[e] = jm.f_code(fname)
-            if isinstance(val, (tuple, list)):
-                v1[e] = mjit.encode_value(val[0] if len(val) > 0 else None)
-                v2[e] = mjit.encode_value(val[1] if len(val) > 1 else None)
-            else:
-                v1[e] = mjit.encode_value(val)
+        f[e], v1[e], v2[e] = jm.encode_entry(es.f[e], es.value_out[e], codec)
         crashed[e] = bool(es.crashed[e])
         c = int(es.call_pos[e]) + 1
         r = int(es.ret_pos[e]) + 1
@@ -123,23 +113,33 @@ def encode_entries(es: Entries, jm: mjit.JitModel, n_pad: int) -> dict:
     }
 
 
-def _hash_key(lin: jnp.ndarray, state) -> jnp.ndarray:
-    """FNV-ish fold of the bitset words and state into a uint32."""
+def _hash_key(lin: jnp.ndarray, state: jnp.ndarray, state_in_key: bool) -> jnp.ndarray:
+    """FNV-ish fold of the memo key (bitset words, plus state words when
+    state participates in the key) into a uint32."""
     h = jnp.uint32(2166136261)
     for w in range(lin.shape[0]):
         h = (h ^ lin[w]) * jnp.uint32(16777619)
-    h = (h ^ state.astype(jnp.uint32)) * jnp.uint32(16777619)
+    if state_in_key:
+        for w in range(state.shape[0]):
+            h = (h ^ state[w].astype(jnp.uint32)) * jnp.uint32(16777619)
     h = h ^ (h >> 15)
     return h
 
 
-def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
+def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
                 max_steps: int):
-    """The complete DFS for one lane. All shapes static."""
+    """The complete DFS for one lane. All shapes static.
+
+    Model state is an int32[n_state] vector (width 1 for the scalar
+    models). Two model-declared structural facts shrink the kernel:
+    state_in_key=False drops the state words from the memo key (sound
+    when state is a function of the linearized bitset, as for the
+    unordered queue), and has_unstep=True replaces the per-depth state
+    snapshot stack with an exact inverse transition on backtrack."""
     n_pad = ent["f"].shape[0]
     cache_size = 1 << cache_bits
     mask = jnp.uint32(cache_size - 1)
-    key_width = n_words + 1  # bitset words + state
+    key_width = n_words + (n_state if jm.state_in_key else 0)
 
     # cache: keys[cache_size, key_width], used[cache_size]
     cache_keys = jnp.zeros((cache_size, key_width), jnp.int32)
@@ -149,11 +149,10 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
         nxt=ent["nxt0"].astype(jnp.int32),
         prv=ent["prv0"].astype(jnp.int32),
         node=ent["nxt0"][0].astype(jnp.int32),
-        state=jnp.int32(step_fn.init_state),
+        state=jnp.asarray(jm.init_vec(n_state), jnp.int32),
         linearized=jnp.zeros(n_words, jnp.uint32),
         depth=jnp.int32(0),
         stack_e=jnp.zeros(n_pad, jnp.int32),
-        stack_s=jnp.zeros(n_pad, jnp.int32),
         completed_done=jnp.int32(0),
         cache_keys=cache_keys,
         cache_used=cache_used,
@@ -162,6 +161,8 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
             ent["n_completed"] == 0, jnp.int32(VALID), jnp.int32(RUNNING)
         ),
     )
+    if not jm.has_unstep:
+        init["stack_s"] = jnp.zeros((n_pad, n_state), jnp.int32)
 
     f_arr = ent["f"]
     v1_arr = ent["v1"]
@@ -186,7 +187,7 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
         e = node_entry_arr[node]
         is_call = (node != 0) & node_is_call_arr[node]
 
-        new_state, ok = step_fn.step(state, f_arr[e], v1_arr[e], v2_arr[e])
+        new_state, ok = jm.vec_step(state, f_arr[e], v1_arr[e], v2_arr[e])
         new_state = new_state.astype(jnp.int32)
         can_lin = is_call & ok
 
@@ -195,10 +196,11 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
         new_lin = lin.at[word].set(lin[word] | bit)
 
         # ---- cache probe (exact full-key compare) ----
-        key = jnp.concatenate(
-            [new_lin.astype(jnp.int32), new_state[None]]
-        )
-        h = _hash_key(new_lin, new_state)
+        key_parts = [new_lin.astype(jnp.int32)]
+        if jm.state_in_key:
+            key_parts.append(new_state)
+        key = jnp.concatenate(key_parts)
+        h = _hash_key(new_lin, new_state, jm.state_in_key)
         probe_idx = (h[None] + jnp.arange(N_PROBES, dtype=jnp.uint32)) & mask
         probe_idx = probe_idx.astype(jnp.int32)
         slot_keys = st["cache_keys"][probe_idx]          # [P, key_width]
@@ -228,7 +230,6 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
         l_prv = l_prv.at[l_nxt[rn]].set(l_prv[rn])
 
         lift_stack_e = st["stack_e"].at[depth].set(e)
-        lift_stack_s = st["stack_s"].at[depth].set(state)
         lift_completed = st["completed_done"] + jnp.where(
             crashed_arr[e], 0, 1
         ).astype(jnp.int32)
@@ -238,7 +239,14 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
         # ---- branch: backtrack (hit a return node / END) ----
         can_pop = depth > 0
         e2 = st["stack_e"][depth - 1]
-        pop_state = st["stack_s"][depth - 1]
+        if jm.has_unstep:
+            # exact inverse of the popped (applied) transition — no
+            # snapshot stack needed
+            pop_state = jm.vec_unstep(
+                state, f_arr[e2], v1_arr[e2], v2_arr[e2]
+            ).astype(jnp.int32)
+        else:
+            pop_state = st["stack_s"][depth - 1]
         cn2 = call_node_arr[e2]
         rn2 = ret_node_arr[e2]
         # relink rn2 then cn2 (reverse of lift order)
@@ -288,7 +296,6 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
             jnp.where(can_pop, pop_completed, st["completed_done"]),
         )
         stack_e_out = jnp.where(do_lift, lift_stack_e, st["stack_e"])
-        stack_s_out = jnp.where(do_lift, lift_stack_s, st["stack_s"])
         cache_keys_out = jnp.where(do_lift, lift_cache_keys, st["cache_keys"])
         cache_used_out = jnp.where(do_lift, lift_cache_used, st["cache_used"])
 
@@ -300,7 +307,7 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
             ),
         )
 
-        return dict(
+        out = dict(
             nxt=nxt_out,
             prv=prv_out,
             node=node_out,
@@ -308,13 +315,17 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
             linearized=lin_out,
             depth=depth_out,
             stack_e=stack_e_out,
-            stack_s=stack_s_out,
             completed_done=completed_out,
             cache_keys=cache_keys_out,
             cache_used=cache_used_out,
             steps=st["steps"] + 1,
             verdict=verdict,
         )
+        if not jm.has_unstep:
+            out["stack_s"] = jnp.where(
+                do_lift, st["stack_s"].at[depth].set(state), st["stack_s"]
+            )
+        return out
 
     out = lax.while_loop(cond, body, init)
     final_verdict = jnp.where(
@@ -323,15 +334,16 @@ def _search_one(ent: dict, step_fn, n_words: int, cache_bits: int,
     return final_verdict, out["steps"], out["depth"]
 
 
-def build_kernel(jm: mjit.JitModel, n_pad: int, cache_bits: int = DEFAULT_CACHE_BITS,
+def build_kernel(jm, n_pad: int, n_state: int = 1,
+                 cache_bits: int = DEFAULT_CACHE_BITS,
                  max_steps: int = DEFAULT_MAX_STEPS):
-    """A jitted batch kernel for histories padded to n_pad entries:
-    dict of stacked arrays -> (verdicts, steps, depths), vmapped over the
-    leading lane axis."""
+    """A jitted batch kernel for histories padded to n_pad entries with
+    int32[n_state] model state: dict of stacked arrays -> (verdicts,
+    steps, depths), vmapped over the leading lane axis."""
     n_words = max(1, (n_pad + 31) // 32)
 
     def one(ent):
-        return _search_one(ent, jm, n_words, cache_bits, max_steps)
+        return _search_one(ent, jm, n_state, n_words, cache_bits, max_steps)
 
     return jax.jit(jax.vmap(one))
 
@@ -339,10 +351,13 @@ def build_kernel(jm: mjit.JitModel, n_pad: int, cache_bits: int = DEFAULT_CACHE_
 _kernel_cache: dict = {}
 
 
-def _kernel_for(jm: mjit.JitModel, n_pad: int, cache_bits: int, max_steps: int):
-    key = (jm.name, n_pad, cache_bits, max_steps)
+def _kernel_for(jm, n_pad: int, n_state: int, cache_bits: int,
+                max_steps: int):
+    key = (jm.name, n_pad, n_state, cache_bits, max_steps)
     if key not in _kernel_cache:
-        _kernel_cache[key] = build_kernel(jm, n_pad, cache_bits, max_steps)
+        _kernel_cache[key] = build_kernel(
+            jm, n_pad, n_state, cache_bits, max_steps
+        )
     return _kernel_cache[key]
 
 
@@ -375,6 +390,11 @@ def analysis_batch(
     if not entries_list:
         return []
     n_pad = _pad_size(max(len(es) for es in entries_list))
+    # state width: max over lanes, bucketed like n_pad to bound
+    # recompiles (lanes narrower than the bucket just never touch the
+    # padding slots — their codecs only emit indices < their own width)
+    n_state = max(jm.lane_width(es) for es in entries_list)
+    n_state = 1 if n_state <= 1 else _next_pow2(n_state)
     ents = [encode_entries(es, jm, n_pad) for es in entries_list]
     n_lanes = len(ents)
     batch = _stack(ents)
@@ -397,7 +417,7 @@ def analysis_batch(
         sharding = NamedSharding(mesh, P("keys"))
         batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
-    kernel = _kernel_for(jm, n_pad, cache_bits, max_steps)
+    kernel = _kernel_for(jm, n_pad, n_state, cache_bits, max_steps)
     verdicts, steps, _depths = jax.block_until_ready(kernel(batch))
     verdicts = np.asarray(verdicts)[:n_lanes]
     steps = np.asarray(steps)[:n_lanes]

@@ -97,10 +97,13 @@ def random_mutex_history(n_process=3, n_ops=14, seed=0, corrupt=0.0,
     return index(history)
 
 
-def random_queue_history(n_process=3, n_ops=16, n_values=4, seed=0,
+def corpus_queue_history(n_process=3, n_ops=16, n_values=4, seed=0,
                          corrupt=0.0, crash=0.08):
     """Concurrent enqueue/dequeue against a real multiset (unordered
-    queue semantics) — valid by construction unless corrupted."""
+    queue semantics) — valid by construction unless corrupted. Distinct
+    from helpers.random_queue_history (different corruption/fail rules);
+    the committed corpus bits depend on THIS generator — don't merge
+    them."""
     rng = random.Random(seed)
     history, t = [], 0
     bag: list = []
@@ -264,7 +267,7 @@ def generate():
     # Unordered queue
     for i in range(10):
         corrupt = 0.35 * (i % 2)
-        hist = random_queue_history(
+        hist = corpus_queue_history(
             n_process=3, n_ops=10 + 5 * i, seed=4000 + i, corrupt=corrupt)
         cases.append(case(
             f"queue-{i}", "unordered-queue", hist,

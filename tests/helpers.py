@@ -106,3 +106,63 @@ def random_register_history(
     for i, o in enumerate(history):
         o.index = i
     return history
+
+
+def random_queue_history(
+    n_process=3,
+    n_ops=12,
+    n_values=None,
+    corrupt=0.0,
+    seed=0,
+):
+    """A random concurrent unordered-queue history produced by simulating
+    a real (atomic) queue with linearization points at invocation —
+    linearizable by construction unless `corrupt` > 0, in which case some
+    dequeue results are randomized (possibly to values never enqueued).
+    n_values=None gives mostly-unique payloads; a small n_values forces
+    duplicate enqueues, exercising multiset count semantics."""
+    from jepsen_tpu.history import Op
+
+    rng = random.Random(seed)
+    if n_values is None:
+        n_values = max(4, n_ops)
+    history = []
+    t = 0
+    q: list = []
+    pending = {}  # process -> (f, value, result)
+    procs = list(range(n_process))
+    ops_started = 0
+    while ops_started < n_ops or pending:
+        p = rng.choice(procs)
+        if p in pending:
+            f, value, result = pending.pop(p)
+            if rng.random() < 0.08:
+                history.append(Op(p, "info", f, value, time=t))
+            else:
+                history.append(Op(p, "ok", f, result, time=t))
+        elif ops_started < n_ops:
+            ops_started += 1
+            if rng.random() < 0.5:
+                f = "enqueue"
+                value = rng.randrange(n_values)
+                q.append(value)
+                result = value
+            else:
+                f = "dequeue"
+                if not q:
+                    # a real queue would reject this dequeue; record :fail
+                    history.append(Op(p, "invoke", f, None, time=t))
+                    t += 1
+                    history.append(Op(p, "fail", f, None, time=t))
+                    t += 1
+                    continue
+                result = q.pop(rng.randrange(len(q)))  # unordered
+                value = None  # dequeue invoke doesn't know its value yet
+                if corrupt and rng.random() < corrupt:
+                    result = rng.randrange(2 * n_values)
+            history.append(Op(p, "invoke", f, value, time=t))
+            pending[p] = (f, value, result)
+        t += 1
+    for i, o in enumerate(history):
+        o.index = i
+    return history

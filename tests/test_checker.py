@@ -272,14 +272,16 @@ class TestReviewRegressions:
         assert linearizable(CASRegister()).check({}, hist, {})["valid"] is True
 
     def test_competition_unknown_does_not_hang(self):
+        # unhashable payloads make the queue tpu-INELIGIBLE (no slot
+        # codec), so the race entrants are exactly (linear, wgl-host)
         hist = h(
-            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
-            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+            invoke_op(0, "enqueue", [1]), ok_op(0, "enqueue", [1]),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", [1]),
         )
         c = linearizable(UnorderedQueue(), algorithm="competition")
         c.time_limit = None
-        # tpu-ineligible model + BOTH entrants (linear, wgl-host) forced
-        # unknown: the race must still return, with an unknown verdict
+        # tpu-ineligible history + BOTH entrants (linear, wgl-host)
+        # forced unknown: the race must still return, verdict unknown
         import jepsen_tpu.ops.linear as ln
         import jepsen_tpu.ops.wgl_host as wh
         orig_w, orig_l = wh.analysis, ln.analysis

@@ -147,4 +147,5 @@ def test_for_model_mapping():
     assert mjit.for_model(CASRegister()) is mjit.cas_register
     assert mjit.for_model(CASRegister(3)) is None  # non-fresh state
     assert mjit.for_model(Mutex()) is mjit.mutex
-    assert mjit.for_model(UnorderedQueue()) is None
+    assert mjit.for_model(UnorderedQueue()) is mjit.unordered_queue
+    assert mjit.for_model(UnorderedQueue((1,))) is None  # non-fresh state

@@ -72,9 +72,16 @@ def test_host_wgl_parity(case):
     budget = case["params"].get("budget")
     if case["expected"] == "unknown":
         r = wgl_host.analysis(model, hist, max_steps=budget["max_steps"])
+        assert r.valid == "unknown", case["name"]
+        return
+    r = wgl_host.analysis(model, hist, max_steps=5_000_000)
+    if case["oracle"] == "linear":
+        # Recorded oracle: WGL exhausted its generation-time budget on
+        # this case and linear decided. WGL may still say "unknown" —
+        # but must never contradict the verdict.
+        assert r.valid in (case["expected"], "unknown"), case["name"]
     else:
-        r = wgl_host.analysis(model, hist)
-    assert r.valid == case["expected"], case["name"]
+        assert r.valid == case["expected"], case["name"]
 
 
 @pytest.mark.parametrize("case", _CASES, ids=_ids(_CASES))
