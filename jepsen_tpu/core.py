@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import datetime
 import logging
+import queue
 import threading
+import time as _time
 from typing import Any
 
 from . import checker as checker_mod
@@ -168,6 +170,46 @@ def invoke_op(op: Op, test, client, abort: threading.Event) -> Op:
     return completion
 
 
+class _InvokerThread:
+    """A reusable single-purpose thread that runs client invokes so the
+    worker can bound its wait. On timeout the worker marks it abandoned
+    and walks away; if the hung call ever finishes, the thread notices
+    the flag and exits (its late completion is discarded — the process
+    was already reincarnated, matching the reference's interrupt
+    semantics, generator.clj:409-518)."""
+
+    def __init__(self, name: str):
+        self.requests: queue.SimpleQueue = queue.SimpleQueue()
+        self.abandoned = False
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True, name=name
+        )
+        self.thread.start()
+
+    def _loop(self):
+        while True:
+            item = self.requests.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            done.set()
+            if self.abandoned:
+                return
+
+    def submit(self, fn):
+        box: dict = {}
+        done = threading.Event()
+        self.requests.put((fn, box, done))
+        return box, done
+
+    def stop(self):
+        self.requests.put(None)
+
+
 class ClientWorker(Worker):
     """One worker per initial process id, bound to a node
     (core.clj:352-440)."""
@@ -179,6 +221,8 @@ class ClientWorker(Worker):
         self.process = process
         self.client = None
         self.name = f"worker {process}"
+        self._invoker: _InvokerThread | None = None
+        self._client_hung = False
 
     def _open_client(self):
         """open then setup, like the reference's open-compat!
@@ -218,6 +262,7 @@ class ClientWorker(Worker):
             o = generator.op_and_validate(gen, test, self.process)
             if o is None:
                 return
+            op_deadline = o.pop(generator.DEADLINE_KEY, None)
             op = Op.from_dict(o).with_(
                 process=self.process, time=relative_time_nanos()
             )
@@ -241,7 +286,7 @@ class ClientWorker(Worker):
                     self.client = None
                     continue
             conj_op(test, op)
-            completion = invoke_op(op, test, self.client, self.abort)
+            completion = self._invoke(op, op_deadline)
             conj_op(test, completion)
             log_op_logger(completion)
             if completion.is_info:
@@ -249,15 +294,89 @@ class ClientWorker(Worker):
                 # effect. The process is hung; reincarnate it so each
                 # logical process stays single-threaded (core.clj:410-427).
                 self.process += test["concurrency"]
-                try:
-                    # bare close — no teardown: the DB's shared state must
-                    # survive for the other workers (core.clj:425-427)
-                    self.client.close(test)
-                except Exception:  # noqa: BLE001
-                    log.warning("Error closing client", exc_info=True)
-                self.client = None
+                client, self.client = self.client, None
+                if self._client_hung:
+                    # the invoker still holds the client mid-call; closing
+                    # synchronously could hang this worker too — close
+                    # best-effort off-thread (core.clj's interrupt path)
+                    self._client_hung = False
+                    threading.Thread(
+                        target=self._close_quietly,
+                        args=(client,),
+                        daemon=True,
+                        name=f"jepsen close {self.name}",
+                    ).start()
+                else:
+                    self._close_quietly(client)
+
+    def _close_quietly(self, client):
+        try:
+            # bare close — no teardown: the DB's shared state must
+            # survive for the other workers (core.clj:425-427)
+            client.close(self.test)
+        except Exception:  # noqa: BLE001
+            log.warning("Error closing client", exc_info=True)
+
+    def _invoke(self, op: Op, deadline=None) -> Op:
+        """Invoke with the wait bounded by op_timeout and the op's
+        time-limit deadline (attached by generator.TimeLimit); on expiry
+        the op completes :info and the hung invoke is abandoned (the
+        reference interrupts the worker thread at the time limit,
+        generator.clj:409-518)."""
+        test = self.test
+        timeout = test.get("op_timeout")
+        if deadline is not None:
+            remaining = deadline - _time.monotonic()
+            timeout = (
+                remaining if timeout is None else min(timeout, remaining)
+            )
+        if timeout is None:
+            return invoke_op(op, test, self.client, self.abort)
+        if self._invoker is None:
+            self._invoker = _InvokerThread(f"jepsen invoker {self.name}")
+        invoker = self._invoker
+        client = self.client
+        box, done = invoker.submit(
+            lambda: invoke_op(op, test, client, self.abort)
+        )
+        if done.wait(max(0.0, timeout)):
+            if "error" in box:
+                raise box["error"]
+            return box["result"]
+        invoker.abandoned = True
+        # also enqueue the stop sentinel: if the call completed in the
+        # instant after wait() expired, the thread may have re-entered
+        # get() before seeing abandoned — the sentinel unblocks it so
+        # the thread can't leak
+        invoker.stop()
+        self._invoker = None
+        self._client_hung = True
+        log.warning(
+            "Process %s timed out after %.1fs; abandoning invoke",
+            op.process,
+            max(0.0, timeout),
+        )
+        return op.with_(
+            type="info",
+            time=relative_time_nanos(),
+            error="op timed out",
+        )
 
     def teardown(self):
+        if self._invoker is not None:
+            self._invoker.stop()
+            self._invoker = None
+        if self._client_hung:
+            # teardown/close would block on the hung connection
+            client, self.client = self.client, None
+            if client is not None:
+                threading.Thread(
+                    target=self._close_quietly,
+                    args=(client,),
+                    daemon=True,
+                    name=f"jepsen close {self.name}",
+                ).start()
+            return
         self._close_client()
 
 
@@ -283,6 +402,9 @@ class NemesisWorker(Worker):
             o = generator.op_and_validate(gen, test, generator.NEMESIS)
             if o is None:
                 return
+            # nemesis invokes aren't deadline-bounded, but strip the
+            # time-limit annotation so it doesn't leak into the history
+            o.pop(generator.DEADLINE_KEY, None)
             op = Op.from_dict(o).with_(
                 process=generator.NEMESIS, time=relative_time_nanos()
             )

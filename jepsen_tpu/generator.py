@@ -464,10 +464,19 @@ def limit(n, gen) -> Limit:
     return Limit(n, gen)
 
 
+DEADLINE_KEY = "_deadline"
+
+
 class TimeLimit(Generator):
-    """Ops until dt seconds after the first request (the reference adds
-    thread-interrupt machinery, generator.clj:409-524; here workers use
-    client-level timeouts instead, so a deadline check suffices)."""
+    """Ops until dt seconds after the first request. The reference bounds
+    stuck *completions* too, by interrupting worker threads at the
+    deadline (generator.clj:409-524); here every op emitted through the
+    time limit carries the deadline (monotonic seconds) under
+    DEADLINE_KEY, and the engine bounds that op's invoke wait by it
+    (core.ClientWorker._invoke), abandoning the hung call and
+    reincarnating the process on expiry. Attaching per-op keeps the bound
+    scoped: ops drawn from sibling generators without a time limit are
+    never capped by this one."""
 
     def __init__(self, dt, gen):
         self.dt = dt
@@ -482,7 +491,13 @@ class TimeLimit(Generator):
             deadline = self._deadline
         if _time.monotonic() >= deadline:
             return None
-        return self.gen.op(test, process)
+        r = self.gen.op(test, process)
+        if r is None:
+            return None
+        r = dict(r)  # never mutate shared op literals
+        prior = r.get(DEADLINE_KEY)
+        r[DEADLINE_KEY] = deadline if prior is None else min(prior, deadline)
+        return r
 
 
 def time_limit(dt, gen) -> TimeLimit:

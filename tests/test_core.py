@@ -145,3 +145,127 @@ class TestDeterminacyRules:
         fails = [o for o in test["history"] if o.is_fail]
         assert fails
         assert test["results"]["valid"] is True
+
+
+class TestOpTimeouts:
+    """Worker-level invoke bounding: a hung client cannot extend the run
+    past time_limit, and op_timeout caps each invoke (the engine-side
+    analog of the reference's interrupt machinery, generator.clj:409-518)."""
+
+    class HangingClient:
+        """invoke blocks until the test process would otherwise hang."""
+
+        def __init__(self, hang=3600.0, state=None):
+            self.hang = hang
+            self.state = state
+            self.release = threading.Event()
+
+        def open(self, test, node):
+            return TestOpTimeouts.HangingClient(self.hang, self.state)
+
+        def setup(self, test):
+            pass
+
+        def invoke(self, test, op):
+            self.release.wait(self.hang)
+            return op.with_(type="ok")
+
+        def teardown(self, test):
+            pass
+
+        def close(self, test):
+            pass
+
+    def test_time_limit_bounds_hung_client(self):
+        import time
+
+        test = cas_test()
+        test["client"] = self.HangingClient()
+        test["generator"] = gen.clients(
+            gen.time_limit(1.0, {"f": "write", "value": 1})
+        )
+        t0 = time.monotonic()
+        test = core.run(test)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0, f"run took {elapsed:.1f}s despite 1s limit"
+        infos = [
+            o
+            for o in test["history"]
+            if o.is_info and isinstance(o.process, int)
+        ]
+        assert infos, "hung invokes must complete :info"
+        assert any(o.error == "op timed out" for o in infos)
+
+    def test_op_timeout_reincarnates(self):
+        test = cas_test()
+        test["client"] = self.HangingClient()
+        test["op_timeout"] = 0.1
+        test["generator"] = gen.clients(
+            gen.limit(3, {"f": "write", "value": 1})
+        )
+        test = core.run(test)
+        hist = test["history"]
+        infos = [o for o in hist if o.is_info and isinstance(o.process, int)]
+        assert len(infos) == 3
+        # each timeout reincarnated the process (process += concurrency)
+        procs = {o.process for o in infos}
+        assert len(procs) == 3
+
+    def test_fast_ops_unaffected_by_op_timeout(self):
+        state = SharedAtom()
+        test = cas_test(state)
+        test["op_timeout"] = 5.0
+        test = core.run(test)
+        assert test["results"]["valid"] is True
+        assert not any(
+            o.error == "op timed out" for o in test["history"]
+        )
+
+
+class TestOpDeadlineAnnotation:
+    def test_time_limit_annotates_ops(self):
+        import time
+
+        literal = {"f": "write", "value": 1}
+        g = gen.time_limit(30.0, literal)
+        with gen.with_threads([0]):
+            o = g.op({}, 0)
+        assert o is not None
+        assert gen.DEADLINE_KEY in o
+        assert o[gen.DEADLINE_KEY] > time.monotonic() + 20
+        # the shared literal itself must not be mutated
+        assert gen.DEADLINE_KEY not in literal
+
+    def test_nested_time_limits_take_min(self):
+        import time
+
+        g = gen.time_limit(
+            30.0, gen.time_limit(5.0, {"f": "write", "value": 1})
+        )
+        with gen.with_threads([0]):
+            o = g.op({}, 0)
+        assert o[gen.DEADLINE_KEY] < time.monotonic() + 6
+
+    def test_sibling_generators_not_capped(self):
+        """A time limit on one branch must not bound ops from another
+        (scoping: the deadline rides the op, not the test)."""
+        import time
+
+        limited = gen.time_limit(0.05, {"f": "write", "value": 1})
+        free = {"f": "read", "value": None}
+        g = gen.concat(limited, free)
+        with gen.with_threads([0]):
+            assert g.op({}, 0)[gen.DEADLINE_KEY] is not None
+            time.sleep(0.06)
+            o = g.op({}, 0)
+        assert o["f"] == "read"
+        assert gen.DEADLINE_KEY not in o
+
+    def test_deadline_stripped_from_history(self):
+        test = cas_test()
+        test["generator"] = gen.clients(
+            gen.time_limit(5.0, gen.limit(5, gen.cas))
+        )
+        test = core.run(test)
+        for o in test["history"]:
+            assert gen.DEADLINE_KEY not in (o.extra or {})
