@@ -202,7 +202,14 @@ class LocalRemote(Remote):
 
 class SshRemote(Remote):
     """OpenSSH subprocess transport with retry-on-corruption
-    (control.clj:141-161) and scp file transfer (control.clj:199-231)."""
+    (control.clj:141-161) and scp file transfer (control.clj:199-231).
+
+    Persistent by default via OpenSSH connection multiplexing: every
+    exec/scp shares one master connection per node (ControlMaster=auto +
+    ControlPath socket + ControlPersist), the analog of the reference's
+    one JSch session per node held for the whole test (core.clj:611-620).
+    connect() primes the master so nemesis grudges touching many nodes
+    pay the handshake once, not per command."""
 
     def __init__(
         self,
@@ -211,12 +218,34 @@ class SshRemote(Remote):
         private_key_path: str | None = None,
         strict_host_key_checking: bool = False,
         connect_timeout: int = 10,
+        control_master: bool = True,
+        control_persist: int = 60,
     ):
         self.username = username
         self.port = port
         self.private_key_path = private_key_path
         self.strict = strict_host_key_checking
         self.connect_timeout = connect_timeout
+        self.control_master = control_master
+        self.control_persist = control_persist
+        self._control_dir: str | None = None
+        self._lock = threading.Lock()
+
+    def _control_path_dir(self) -> str:
+        """Socket dir, created lazily (kept short: unix socket paths cap
+        out near 104 bytes)."""
+        with self._lock:
+            if self._control_dir is None:
+                import shutil
+                import tempfile
+                import weakref
+
+                self._control_dir = tempfile.mkdtemp(prefix="jt-cm-")
+                weakref.finalize(
+                    self, shutil.rmtree, self._control_dir,
+                    ignore_errors=True,
+                )
+            return self._control_dir
 
     def _opts(self) -> list:
         o = [
@@ -229,7 +258,37 @@ class SshRemote(Remote):
                   "-o", "UserKnownHostsFile=/dev/null", "-o", "LogLevel=ERROR"]
         if self.private_key_path:
             o += ["-i", self.private_key_path]
+        if self.control_master:
+            o += [
+                "-o", "ControlMaster=auto",
+                "-o", f"ControlPath={self._control_path_dir()}/%C",
+                "-o", f"ControlPersist={self.control_persist}",
+                # a mux'd command has no fresh TCP connect, so
+                # ConnectTimeout can't bound it — keepalives detect a
+                # dead/black-holed master instead (~15s)
+                "-o", "ServerAliveInterval=5",
+                "-o", "ServerAliveCountMax=3",
+            ]
         return o
+
+    def connect(self, node) -> None:
+        """Prime the per-node master connection (core.clj:611-620 opens
+        one session per node up front); raises if the node is
+        unreachable, like the reference's with-ssh."""
+        self.exec(node, ["true"], retries=1)
+
+    def disconnect(self, node) -> None:
+        """Ask the master for this node to exit; best-effort."""
+        if not self.control_master or self._control_dir is None:
+            return
+        try:
+            subprocess.run(
+                ["ssh", *self._opts(), "-O", "exit",
+                 f"{self.username}@{node}"],
+                capture_output=True, text=True, timeout=10,
+            )
+        except Exception:  # noqa: BLE001
+            log.debug("ssh -O exit failed for %s", node, exc_info=True)
 
     def exec(self, node, cmd, sudo=None, cd=None, stdin=None, timeout=None,
              check=True, retries=3) -> Result:
@@ -281,6 +340,8 @@ def remote_for_test(test: Mapping) -> Remote:
         port=ssh.get("port", 22),
         private_key_path=ssh.get("private_key_path"),
         strict_host_key_checking=ssh.get("strict_host_key_checking", False),
+        control_master=ssh.get("control_master", True),
+        control_persist=ssh.get("control_persist", 60),
     )
 
 

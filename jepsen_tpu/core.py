@@ -189,7 +189,9 @@ class _InvokerThread:
     def _loop(self):
         while True:
             item = self.requests.get()
-            if item is None:
+            if item is None or self.abandoned:
+                # don't start work nobody is waiting on (an op whose
+                # deadline already expired is abandoned before it runs)
                 return
             fn, box, done = item
             try:
@@ -540,8 +542,9 @@ def run(test: dict) -> dict:
         store = None  # type: ignore[assignment]
 
     try:
-        for node in test["nodes"]:
-            test["remote"].connect(node)
+        # prime per-node connections in parallel, with rollback-free
+        # semantics: any failure aborts the run (core.clj:611-620)
+        real_pmap(test["remote"].connect, test["nodes"])
         try:
             # OS setup
             osys = test.get("os")

@@ -1,0 +1,121 @@
+"""SshRemote connection-multiplexing tests.
+
+No real sshd exists in CI, so a stub `ssh`/`scp` on PATH records argv
+and emulates ControlMaster behavior: the first call per ControlPath pays
+a simulated handshake (sleep + touch socket file), subsequent calls are
+instant. This pins the persistent-session contract (one master per node,
+shared by exec and scp, closed by disconnect) that the reference gets
+from holding a JSch session per node (core.clj:611-620).
+"""
+
+import os
+import stat
+
+import pytest
+
+from jepsen_tpu.control import SshRemote
+
+SSH_STUB = """#!/bin/bash
+# record argv for assertions
+echo "$@" >> "$STUB_LOG"
+cp=""
+prev=""
+for a in "$@"; do
+  case "$prev" in
+    -o) case "$a" in ControlPath=*) cp="${a#ControlPath=}";; esac;;
+  esac
+  prev="$a"
+done
+# -O exit: drop the master
+for a in "$@"; do
+  if [ "$a" = "-O" ]; then
+    [ -n "$cp" ] && rm -f "$cp.master"
+    exit 0
+  fi
+done
+if [ -n "$cp" ]; then
+  if [ ! -e "$cp.master" ]; then
+    echo "HANDSHAKE" >> "$STUB_LOG"
+    sleep 0.1            # simulated TCP+auth handshake
+    touch "$cp.master"
+  fi
+else
+  echo "HANDSHAKE" >> "$STUB_LOG"
+  sleep 0.1              # no multiplexing: full handshake every time
+fi
+echo ok
+"""
+
+
+@pytest.fixture
+def stub_ssh(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    log_file = tmp_path / "argv.log"
+    for name in ("ssh", "scp"):
+        p = bindir / name
+        p.write_text(SSH_STUB)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("STUB_LOG", str(log_file))
+    return log_file
+
+
+class TestControlMaster:
+    def test_opts_request_multiplexing(self):
+        r = SshRemote()
+        opts = r._opts()
+        assert "ControlMaster=auto" in opts
+        assert any(o.startswith("ControlPath=") for o in opts)
+        assert any(o.startswith("ControlPersist=") for o in opts)
+
+    def test_control_master_can_be_disabled(self):
+        r = SshRemote(control_master=False)
+        assert not any("ControlMaster" in str(o) for o in r._opts())
+
+    def test_handshake_amortized(self, stub_ssh):
+        """connect() pays the one handshake; later execs ride the master
+        (assert on handshake count, not wall clock, to stay robust on
+        loaded CI machines)."""
+        r = SshRemote(control_master=True)
+        r.connect("n1")
+        for _ in range(5):
+            r.exec("n1", ["true"])
+        handshakes = stub_ssh.read_text().count("HANDSHAKE")
+        assert handshakes == 1, (
+            f"expected 1 handshake for connect+5 execs, saw {handshakes}"
+        )
+
+    def test_without_master_every_exec_pays(self, stub_ssh):
+        r = SshRemote(control_master=False)
+        for _ in range(2):
+            r.exec("n1", ["true"])
+        assert stub_ssh.read_text().count("HANDSHAKE") == 2
+
+    def test_disconnect_exits_master(self, stub_ssh):
+        r = SshRemote()
+        r.connect("n1")
+        r.disconnect("n1")
+        log_text = stub_ssh.read_text()
+        assert "-O exit" in log_text
+        # master socket marker removed by the stub on -O exit
+        d = r._control_path_dir()
+        assert not any(f.endswith(".master") for f in os.listdir(d))
+
+    def test_scp_shares_control_path(self, stub_ssh, tmp_path):
+        r = SshRemote()
+        r.connect("n1")
+        src = tmp_path / "f.txt"
+        src.write_text("hi")
+        r.upload("n1", src, "/tmp/f.txt")
+        assert stub_ssh.read_text().count("HANDSHAKE") == 1, (
+            "scp should reuse the exec master"
+        )
+        log_lines = stub_ssh.read_text().splitlines()
+        cps = {
+            tok.split("=", 1)[1]
+            for line in log_lines
+            for tok in line.split()
+            if tok.startswith("ControlPath=")
+        }
+        assert len(cps) == 1, f"exec and scp must share one ControlPath: {cps}"
