@@ -158,6 +158,13 @@ def add_repo(remote: Remote, node, repo_name: str, apt_line: str,
         update(remote, node)
 
 
+def install_jdk(remote: Remote, node) -> None:
+    """Ensure a JDK is present (os/debian.clj:122-136 installs Oracle
+    jdk8 via the long-dead webupd8 PPA; modern Debian ships OpenJDK in
+    main, so we install that instead of resurrecting the PPA dance)."""
+    install(remote, node, ["default-jdk-headless"])
+
+
 class Debian(OS):
     """Debian provisioning: hostfile, apt update, base packages, heal
     the network (os/debian.clj:138-169)."""

@@ -1,0 +1,331 @@
+"""Hazelcast suite tests: sim data-structure semantics, client
+determinacy taxonomy, DB lifecycle through LocalRemote, and full engine
+runs for the queue / lock / id workloads (reference behavior:
+hazelcast/src/jepsen/hazelcast.clj)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import core, generator as gen, models, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import hazelcast as hz
+from jepsen_tpu.dbs import hz_sim
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+@pytest.fixture
+def sim(tmp_path):
+    """In-process hazelcast-like sim on an ephemeral port."""
+
+    class H(hz_sim.Handler):
+        store = hz_sim.Store(str(tmp_path / "hz-state.json"))
+        mean_latency = 0.0
+        _id_lock = threading.Lock()
+        _id_next = 0
+        _id_limit = 0
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield httpd.server_address[1]
+    httpd.shutdown()
+
+
+def _conn(port) -> hz.HzConn:
+    return hz.HzConn("127.0.0.1", port)
+
+
+def _test_map(port, node="n1") -> dict:
+    return {"hazelcast": {"addr_fn": lambda n: "127.0.0.1",
+                          "ports": {node: port}}}
+
+
+def _inv(f, value=None):
+    return Op(process=0, type="invoke", f=f, value=value)
+
+
+class TestSimStructures:
+    def test_queue_fifo(self, sim):
+        c = _conn(sim)
+        c.call("/queue/put", {"name": "q", "value": 1})
+        c.call("/queue/put", {"name": "q", "value": 2})
+        assert c.call("/queue/poll", {"name": "q", "timeout_ms": 1})["value"] == 1
+        assert c.call("/queue/poll", {"name": "q", "timeout_ms": 1})["value"] == 2
+        assert c.call("/queue/poll", {"name": "q", "timeout_ms": 1})["value"] is None
+
+    def test_lock_mutual_exclusion_and_reentrancy(self, sim):
+        c = _conn(sim)
+        a = c.call("/lock/acquire",
+                   {"name": "l", "session": "s1", "timeout_ms": 10})
+        assert a["acquired"] is True
+        # s2 can't grab it
+        b = c.call("/lock/acquire",
+                   {"name": "l", "session": "s2", "timeout_ms": 10})
+        assert b["acquired"] is False
+        # s1 reenters, then must release twice
+        assert c.call("/lock/acquire",
+                      {"name": "l", "session": "s1", "timeout_ms": 10})[
+            "acquired"] is True
+        c.call("/lock/release", {"name": "l", "session": "s1"})
+        b = c.call("/lock/acquire",
+                   {"name": "l", "session": "s2", "timeout_ms": 10})
+        assert b["acquired"] is False
+        c.call("/lock/release", {"name": "l", "session": "s1"})
+        b = c.call("/lock/acquire",
+                   {"name": "l", "session": "s2", "timeout_ms": 100})
+        assert b["acquired"] is True
+
+    def test_release_by_non_owner_is_error(self, sim):
+        c = _conn(sim)
+        c.call("/lock/acquire", {"name": "l", "session": "s1",
+                                 "timeout_ms": 10})
+        with pytest.raises(hz.HzError) as ei:
+            c.call("/lock/release", {"name": "l", "session": "s2"})
+        assert ei.value.kind == "not-lock-owner"
+
+    def test_atomic_long_and_ref(self, sim):
+        c = _conn(sim)
+        assert c.call("/atomic-long/inc", {"name": "a"})["value"] == 1
+        assert c.call("/atomic-long/inc", {"name": "a"})["value"] == 2
+        assert c.call("/atomic-ref/get", {"name": "r"})["value"] is None
+        assert c.call("/atomic-ref/cas",
+                      {"name": "r", "old": None, "new": 1})["swapped"] is True
+        assert c.call("/atomic-ref/cas",
+                      {"name": "r", "old": 5, "new": 9})["swapped"] is False
+        assert c.call("/atomic-ref/get", {"name": "r"})["value"] == 1
+
+    def test_id_gen_unique(self, sim):
+        c = _conn(sim)
+        ids = [c.call("/id-gen/new", {})["value"] for _ in range(50)]
+        assert len(set(ids)) == 50
+
+    def test_map_cas(self, sim):
+        c = _conn(sim)
+        assert c.call("/map/put-if-absent",
+                      {"name": "m", "key": "hi", "value": [1]})[
+            "previous"] is None
+        assert c.call("/map/put-if-absent",
+                      {"name": "m", "key": "hi", "value": [9]})[
+            "previous"] == [1]
+        assert c.call("/map/replace",
+                      {"name": "m", "key": "hi", "old": [1], "new": [1, 2]})[
+            "replaced"] is True
+        assert c.call("/map/replace",
+                      {"name": "m", "key": "hi", "old": [1], "new": [1, 3]})[
+            "replaced"] is False
+        assert c.call("/map/get", {"name": "m", "key": "hi"})[
+            "value"] == [1, 2]
+
+
+class TestClientTaxonomy:
+    def test_queue_roundtrip_and_empty_fail(self, sim):
+        t = _test_map(sim)
+        c = hz.QueueClient().open(t, "n1")
+        assert c.invoke(t, _inv("enqueue", 7)).type == "ok"
+        d = c.invoke(t, _inv("dequeue"))
+        assert d.type == "ok" and d.value == 7
+        e = c.invoke(t, _inv("dequeue"))
+        assert e.type == "fail" and e.error == "empty"
+
+    def test_queue_drain(self, sim):
+        t = _test_map(sim)
+        c = hz.QueueClient().open(t, "n1")
+        for v in (1, 2, 3):
+            c.invoke(t, _inv("enqueue", v))
+        d = c.invoke(t, _inv("drain"))
+        assert d.type == "ok" and d.value == [1, 2, 3]
+
+    def test_enqueue_to_dead_node_is_info(self):
+        t = _test_map(free_port())
+        c = hz.QueueClient().open(t, "n1")
+        c.conn.timeout = 0.5
+        assert c.invoke(t, _inv("enqueue", 1)).type == "info"
+
+    def test_lock_acquire_release(self, sim):
+        t = _test_map(sim)
+        c1 = hz.LockClient().open(t, "n1")
+        c2 = hz.LockClient().open(t, "n1")
+        assert c1.invoke(t, _inv("acquire")).type == "ok"
+        # c2 times out at the server (we shrink the wait to keep it fast)
+        hz_wait, hz.LOCK_WAIT_MS = hz.LOCK_WAIT_MS, 50
+        try:
+            assert c2.invoke(t, _inv("acquire")).type == "fail"
+        finally:
+            hz.LOCK_WAIT_MS = hz_wait
+        # release by non-owner is a definite fail
+        r = c2.invoke(t, _inv("release"))
+        assert r.type == "fail" and r.error == "not-lock-owner"
+        assert c1.invoke(t, _inv("release")).type == "ok"
+
+    def test_id_clients(self, sim):
+        t = _test_map(sim)
+        for cls in (hz.AtomicLongIdClient, hz.AtomicRefIdClient,
+                    hz.IdGenIdClient):
+            c = cls().open(t, "n1")
+            a = c.invoke(t, _inv("generate"))
+            b = c.invoke(t, _inv("generate"))
+            assert a.type == "ok" and b.type == "ok"
+            assert a.value != b.value, cls
+
+    def test_map_client_add_read(self, sim):
+        t = _test_map(sim)
+        c = hz.MapClient().open(t, "n1")
+        assert c.invoke(t, _inv("add", 3)).type == "ok"
+        assert c.invoke(t, _inv("add", 1)).type == "ok"
+        r = c.invoke(t, _inv("read"))
+        assert r.type == "ok" and r.value == [1, 3]
+
+
+def _sim_cluster(tmp_path, nodes):
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "hz-sim.tar.gz")
+    hz_sim.build_archive(archive, str(tmp_path / "shared" / "hz.json"))
+    cfg = {
+        "addr_fn": lambda n: "127.0.0.1",
+        "ports": {n: free_port() for n in nodes},
+        "dir": lambda n: os.path.join(remote.node_dir(n), "opt", "hz"),
+        "sudo": None,
+    }
+    return remote, archive, cfg
+
+
+class TestDBLifecycle:
+    def test_setup_teardown_cycle(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = _sim_cluster(tmp_path, nodes)
+        database = hz.HazelcastDB(archive_url=f"file://{archive}",
+                                  jdk=False)
+        test = {"remote": remote, "nodes": nodes, "hazelcast": cfg}
+        try:
+            for n in nodes:
+                database.setup(test, n)
+            # members share state
+            c1 = _conn(cfg["ports"]["n1"])
+            c2 = _conn(cfg["ports"]["n2"])
+            c1.call("/queue/put", {"name": "q", "value": 9})
+            assert c2.call("/queue/poll",
+                           {"name": "q", "timeout_ms": 1})["value"] == 9
+            for n in nodes:
+                (path,) = database.log_files(test, n)
+                assert os.path.exists(path)
+        finally:
+            for n in nodes:
+                database.teardown(test, n)
+
+
+def _engine_test(tmp_path, workload, time_limit=6, concurrency=4):
+    nodes = ["n1", "n2"]
+    remote, archive, cfg = _sim_cluster(tmp_path, nodes)
+    opts = {
+        "workload": workload,
+        "nodes": nodes,
+        "remote": remote,
+        "hazelcast": cfg,
+        "archive_url": f"file://{archive}",
+        "os": None,
+        "net": None,
+        "concurrency": concurrency,
+        "time_limit": time_limit,
+        "quiesce": 0.2,
+        "install_jdk": False,  # the sim archive ships its own interpreter
+    }
+    t = hz.hazelcast_test(opts)
+    # hermetic overrides: the suite map wins over opts (the reference's
+    # merge order, hazelcast.clj:421-433), so patch after construction
+    t["nemesis"] = nemesis.noop  # no iptables against localhost
+    t["os"] = None
+    t["net"] = None
+    return t
+
+
+class TestFullRuns:
+    def test_queue_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "queue", time_limit=5)
+        # tighten the stagger so a short run still queues plenty
+        wl = hz.workloads()["queue"]
+        t["client"] = wl["client"]
+        t["generator"] = gen.phases(
+            gen.time_limit(4, gen.clients(gen.stagger(0.01, hz.queue_gen()))),
+            gen.clients(gen.each(
+                lambda: gen.once({"type": "invoke", "f": "drain"}))),
+        )
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        hist = result["history"]
+        assert any(o.f == "drain" and o.type == "ok" for o in hist)
+
+    def test_lock_workload(self, tmp_path):
+        hz_wait, hz.LOCK_WAIT_MS = hz.LOCK_WAIT_MS, 100
+        try:
+            t = _engine_test(tmp_path, "lock", time_limit=4, concurrency=2)
+            result = core.run(t)
+        finally:
+            hz.LOCK_WAIT_MS = hz_wait
+        res = result["results"]
+        assert res["valid"] is True, res
+
+    def test_id_gen_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "id-gen-ids", time_limit=3)
+        t["generator"] = gen.time_limit(
+            2, gen.clients(gen.stagger(
+                0.01, {"type": "invoke", "f": "generate"})))
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        oks = [o for o in result["history"] if o.type == "ok"]
+        assert len(oks) > 10
+
+    def test_map_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "map", time_limit=4)
+        wl = hz.workloads()["map"]
+        t["client"] = wl["client"]
+        t["generator"] = gen.phases(
+            gen.time_limit(3, gen.clients(gen.stagger(
+                0.01, wl["generator"].gen
+                if hasattr(wl["generator"], "gen") else wl["generator"]))),
+            gen.clients(wl["final_generator"]),
+        )
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+
+
+class TestBundleAndCli:
+    def test_workload_registry_complete(self):
+        # hazelcast.clj:377-399 — all seven workloads
+        assert set(hz.workloads()) == {
+            "crdt-map", "map", "lock", "queue",
+            "atomic-ref-ids", "atomic-long-ids", "id-gen-ids",
+        }
+
+    def test_test_bundle(self):
+        t = hz.hazelcast_test({"workload": "queue", "nodes": ["a", "b"],
+                               "time_limit": 5})
+        assert t["name"] == "hazelcast queue"
+        assert isinstance(t["db"], hz.HazelcastDB)
+        assert isinstance(t["client"], hz.QueueClient)
+        assert t["model"] is None
+
+    def test_lock_bundle_has_mutex_model(self):
+        t = hz.hazelcast_test({"workload": "lock", "nodes": ["a"],
+                               "time_limit": 5})
+        assert isinstance(t["model"], models.Mutex)
+        assert isinstance(t["client"], hz.LockClient)
+
+    def test_cli_requires_workload(self, capsys):
+        from jepsen_tpu import cli as cli_mod
+
+        rc = cli_mod.run_cli(
+            {**cli_mod.single_test_cmd(hz.hazelcast_test,
+                                       opt_spec=hz._opt_spec)},
+            ["test", "--time-limit", "1"],
+        )
+        assert rc == 254
