@@ -54,6 +54,61 @@ class Store:
                 fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
+class StoreTxn:
+    """An explicit multi-round-trip transaction on a Store: holds the
+    flock from begin() to commit()/rollback(), with a bounded
+    acquisition wait so contending transactions fail fast instead of
+    queueing forever (crdb_sim surfaces that as SQLSTATE 40001, the
+    shape of CockroachDB's 'restart transaction' errors)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self._lockf = None
+        self.data: dict | None = None
+
+    @property
+    def active(self) -> bool:
+        return self._lockf is not None
+
+    def begin(self, timeout: float = 2.0) -> bool:
+        """True if the lock was acquired and a working snapshot loaded;
+        False on acquisition timeout."""
+        import time as _time
+
+        assert not self.active, "transaction already open"
+        lockf = open(self.store.lock_path, "a")
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                fcntl.flock(lockf, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if _time.monotonic() >= deadline:
+                    lockf.close()
+                    return False
+                _time.sleep(0.005)
+        self._lockf = lockf
+        self.data = self.store._load()
+        return True
+
+    def commit(self) -> None:
+        assert self.active, "no transaction open"
+        try:
+            self.store._save(self.data)
+        finally:
+            self._release()
+
+    def rollback(self) -> None:
+        if self.active:
+            self._release()
+
+    def _release(self) -> None:
+        fcntl.flock(self._lockf, fcntl.LOCK_UN)
+        self._lockf.close()
+        self._lockf = None
+        self.data = None
+
+
 def build_sim_archive(dest: str, module: str, binary: str, arcname: str,
                       data_path: str, mean_latency: float = 0.0,
                       python: str | None = None) -> str:

@@ -1,0 +1,374 @@
+"""CockroachDB suite tests: pgwire protocol round-trip against the sim,
+the mini SQL engine, transaction serialization (40001 on contention),
+client determinacy taxonomy, nemesis registry/composition math, DB
+lifecycle through LocalRemote, and full engine runs for every workload
+(reference behavior: cockroachdb/src/jepsen/cockroach*.clj)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, independent, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import cockroach as cr
+from jepsen_tpu.dbs import cockroach_workloads as crw
+from jepsen_tpu.dbs import crdb_sim, pg_proto
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+@pytest.fixture
+def sim(tmp_path, monkeypatch):
+    """In-process pgwire sim on an ephemeral port, with a fast lock
+    timeout so contention tests don't crawl."""
+    monkeypatch.setattr(crdb_sim, "TXN_LOCK_TIMEOUT", 0.2)
+
+    class H(crdb_sim.Handler):
+        store = crdb_sim.Store(str(tmp_path / "crdb-state.json"))
+        mean_latency = 0.0
+
+    srv = crdb_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def _conn(port) -> pg_proto.PgConn:
+    return pg_proto.PgConn("127.0.0.1", port, timeout=5.0,
+                           connect_timeout=5.0)
+
+
+class TestPgProtoAndEngine:
+    def test_select_one(self, sim):
+        c = _conn(sim)
+        r = c.query("select 1")
+        assert r.rows == [("1",)]
+        c.close()
+
+    def test_ddl_insert_select(self, sim):
+        c = _conn(sim)
+        c.query("create table t (id int primary key, val int)")
+        c.query("insert into t values (1, 10), (2, 20)")
+        r = c.query("select val from t where id = 2")
+        assert r.scalars() == ["20"]
+        r = c.query("select id, val from t")
+        assert sorted(r.rows) == [("1", "10"), ("2", "20")]
+        c.close()
+
+    def test_update_rowcount_cas(self, sim):
+        c = _conn(sim)
+        c.query("create table t (id int, val int)")
+        c.query("insert into t values (1, 5)")
+        assert c.query("update t set val = 6 where id = 1 and val = 5"
+                       ).rowcount == 1
+        assert c.query("update t set val = 7 where id = 1 and val = 5"
+                       ).rowcount == 0
+        c.close()
+
+    def test_max_aggregate_and_modulo(self, sim):
+        c = _conn(sim)
+        c.query("create table m (val int, key int)")
+        assert c.query("select max(val) as m from m").scalars() == [None]
+        c.query("insert into m values (3, 1), (9, 1), (4, 2)")
+        assert c.query("select max(val) as m from m").scalars() == ["9"]
+        r = c.query("select val from m where key = 1 and val % 3 = 0")
+        assert sorted(r.scalars()) == ["3", "9"]
+        c.close()
+
+    def test_duplicate_pkey_rejected(self, sim):
+        c = _conn(sim)
+        c.query("create table t (id int primary key, val int)")
+        c.query("insert into t values (1, 1)")
+        with pytest.raises(pg_proto.PgError) as ei:
+            c.query("insert into t values (1, 2)")
+        assert ei.value.sqlstate == "23505"
+        # connection still usable after the error
+        assert c.query("select 1").rows == [("1",)]
+        c.close()
+
+    def test_cluster_logical_timestamp_monotone(self, sim):
+        c = _conn(sim)
+        a = float(c.query("select cluster_logical_timestamp()").scalars()[0])
+        b = float(c.query("select cluster_logical_timestamp()").scalars()[0])
+        assert b > a
+        c.close()
+
+    def test_txn_commit_and_rollback(self, sim):
+        c = _conn(sim)
+        c.query("create table t (id int, val int)")
+        c.query("begin")
+        c.query("insert into t values (1, 1)")
+        c.query("commit")
+        assert len(c.query("select id from t").rows) == 1
+        c.query("begin")
+        c.query("insert into t values (2, 2)")
+        c.query("rollback")
+        assert len(c.query("select id from t").rows) == 1
+        c.close()
+
+    def test_txn_contention_raises_40001(self, sim):
+        c1, c2 = _conn(sim), _conn(sim)
+        c1.query("create table t (id int, val int)")
+        c1.query("begin")
+        c1.query("insert into t values (1, 1)")
+        with pytest.raises(pg_proto.PgError) as ei:
+            c2.query("begin")
+        assert ei.value.sqlstate == "40001" and ei.value.retryable
+        c1.query("commit")
+        # after commit the lock is free again
+        c2.query("begin")
+        c2.query("rollback")
+        c1.close()
+        c2.close()
+
+    def test_error_inside_txn_aborts_until_rollback(self, sim):
+        c = _conn(sim)
+        c.query("create table t (id int primary key, val int)")
+        c.query("insert into t values (1, 1)")
+        c.query("begin")
+        with pytest.raises(pg_proto.PgError):
+            c.query("insert into t values (1, 9)")  # dup key
+        with pytest.raises(pg_proto.PgError) as ei:
+            c.query("select 1 from t")
+        assert ei.value.sqlstate == "25P02"
+        c.query("rollback")
+        assert c.query("select val from t where id = 1").scalars() == ["1"]
+        c.close()
+
+
+class TestClientHelpers:
+    def test_txn_retry_retries_40001(self):
+        calls = {"n": 0}
+
+        def body():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise pg_proto.PgError("40001", "restart transaction")
+            return "done"
+
+        assert cr.txn_retry(body, backoff=0.001) == "done"
+        assert calls["n"] == 3
+
+    def test_txn_retry_reraises_other_errors(self):
+        def body():
+            raise pg_proto.PgError("23505", "dup")
+
+        with pytest.raises(pg_proto.PgError):
+            cr.txn_retry(body, backoff=0.001)
+
+    def test_exception_taxonomy(self):
+        op = Op(process=0, type="invoke", f="read", value=None)
+        fail = cr.exception_to_op(
+            op, pg_proto.PgError("40001", "restart transaction"))
+        assert fail.type == "fail"
+        info = cr.exception_to_op(op, pg_proto.PgError("XX000", "boom"))
+        assert info.type == "info"
+        refused = cr.exception_to_op(op, ConnectionRefusedError())
+        assert refused.type == "fail" and refused.error == "connection-refused"
+        timeout = cr.exception_to_op(op, TimeoutError())
+        assert timeout.type == "info" and timeout.error == "timeout"
+        assert cr.exception_to_op(op, ValueError()) is None
+
+    def test_with_idempotent_remaps_reads(self):
+        op = Op(process=0, type="info", f="read", value=None)
+        assert cr.with_idempotent({"read"}, op).type == "fail"
+        w = Op(process=0, type="info", f="write", value=None)
+        assert cr.with_idempotent({"read"}, w).type == "info"
+
+
+class TestNemesisRegistry:
+    def test_registry_names(self):
+        names = set(cr.nemeses())
+        assert {"none", "parts", "majority-ring", "start-stop",
+                "start-kill", "small-skews", "big-skews", "huge-skews",
+                "strobe-skews"} <= names
+
+    def test_resolve_single(self):
+        nem = cr.resolve_nemesis({"nemesis": "parts"})
+        assert nem["name"] == "parts"
+        assert isinstance(nem["client"], nemesis.Partitioner)
+
+    def test_resolve_composed_routing(self):
+        nem = cr.resolve_nemesis({"nemesis": "parts",
+                                  "nemesis2": "majority-ring"})
+        assert nem["name"] == "parts+majring"
+        comp = nem["client"]
+        # routing: (name, f) tuples map back to inner fs
+        sub, inner = comp._route(("parts", "start"))
+        assert inner == "start"
+        sub2, inner2 = comp._route(("majring", "stop"))
+        assert inner2 == "stop"
+        assert sub is not sub2
+        with pytest.raises(ValueError):
+            comp._route(("unknown", "start"))
+
+    def test_composed_generator_emits_named_fs(self):
+        nem = cr.resolve_nemesis({"nemesis": "parts",
+                                  "nemesis2": "majority-ring"})
+        test = {"concurrency": 2}
+        with gen.with_threads(["nemesis"]):
+            ops = []
+            deadline = time.monotonic() + 1.0
+            while len(ops) < 2 and time.monotonic() < deadline:
+                op = nem["final"].op(test, "nemesis")
+                if op is None:
+                    break
+                ops.append(op)
+        fs = {o["f"] for o in ops}
+        assert fs == {("parts", "stop"), ("majring", "stop")}
+
+
+def _sim_cluster(tmp_path, nodes):
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "crdb-sim.tar.gz")
+    crdb_sim.build_archive(archive, str(tmp_path / "shared" / "crdb.json"))
+    cfg = {
+        "addr_fn": lambda n: "127.0.0.1",
+        "ports": {n: free_port() for n in nodes},
+        "dir": lambda n: os.path.join(remote.node_dir(n), "opt", "crdb"),
+        "sudo": None,
+    }
+    return remote, archive, cfg
+
+
+class TestDBLifecycle:
+    def test_setup_teardown_cycle(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = _sim_cluster(tmp_path, nodes)
+        database = cr.CockroachDB(tarball=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "cockroach": cfg}
+        try:
+            for n in nodes:
+                database.setup(test, n)
+            c1 = _conn(cfg["ports"]["n1"])
+            c2 = _conn(cfg["ports"]["n2"])
+            c1.query("create table x (id int)")
+            c1.query("insert into x values (7)")
+            assert c2.query("select id from x").scalars() == ["7"]
+            c1.close()
+            c2.close()
+            for n in nodes:
+                (path,) = database.log_files(test, n)
+                assert os.path.exists(path)
+        finally:
+            for n in nodes:
+                database.teardown(test, n)
+
+
+def _engine_test(tmp_path, workload, time_limit=5, concurrency=4, **extra):
+    nodes = ["n1", "n2"]
+    remote, archive, cfg = _sim_cluster(tmp_path, nodes)
+    opts = {
+        "workload": workload,
+        "nodes": nodes,
+        "remote": remote,
+        "cockroach": cfg,
+        "tarball": f"file://{archive}",
+        "concurrency": concurrency,
+        "time_limit": time_limit,
+        "quiesce": 0.2,
+        "nemesis": "none",
+        **extra,
+    }
+    t = crw.cockroach_test(opts)
+    t["os"] = None
+    t["net"] = None
+    return t
+
+
+class TestFullRuns:
+    def test_register_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "register", time_limit=5,
+                         ops_per_key=20, threads_per_key=2)
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        oks = [o for o in result["history"] if o.type == "ok"]
+        assert len(oks) > 10
+
+    def test_bank_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "bank", time_limit=5)
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        reads = [o for o in result["history"]
+                 if o.type == "ok" and o.f == "read"]
+        assert reads and all(sum(r.value.values()) == 50 for r in reads)
+
+    def test_sets_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "sets", time_limit=4)
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        final = [o for o in result["history"]
+                 if o.type == "ok" and o.f == "read"]
+        assert final and len(final[-1].value) > 0
+
+    def test_monotonic_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "monotonic", time_limit=4)
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+
+    def test_g2_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "g2", time_limit=4)
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+
+
+class TestMonotonicChecker:
+    def _read_op(self, rows):
+        return [
+            Op(process=0, type="invoke", f="read", value=None, index=0),
+            Op(process=0, type="ok", f="read", value=rows, index=1),
+        ]
+
+    def test_ordered_rows_valid(self):
+        rows = [(1, "1.0", 0, 0), (2, "2.0", 0, 0), (3, "10.0", 1, 1)]
+        res = crw.MonotonicChecker().check({}, self._read_op(rows), {})
+        assert res["valid"] is True
+
+    def test_reorder_detected(self):
+        rows = [(2, "1.0", 0, 0), (1, "2.0", 0, 0)]
+        res = crw.MonotonicChecker().check({}, self._read_op(rows), {})
+        assert res["valid"] is False and res["reorders"]
+
+    def test_duplicate_detected(self):
+        rows = [(1, "1.0", 0, 0), (1, "2.0", 0, 0)]
+        res = crw.MonotonicChecker().check({}, self._read_op(rows), {})
+        assert res["valid"] is False and res["duplicates"] == {1: 2}
+
+    def test_never_read_unknown(self):
+        res = crw.MonotonicChecker().check({}, [], {})
+        assert res["valid"] == "unknown"
+
+
+class TestCli:
+    def test_workload_registry(self):
+        assert set(crw.workloads()) == {
+            "register", "bank", "sets", "monotonic", "g2"}
+
+    def test_cli_requires_workload(self):
+        from jepsen_tpu import cli as cli_mod
+
+        rc = cli_mod.run_cli(
+            {**cli_mod.single_test_cmd(crw.cockroach_test,
+                                       opt_spec=crw._opt_spec)},
+            ["test", "--time-limit", "1"],
+        )
+        assert rc == 254
+
+    def test_bundle_name_carries_nemesis(self):
+        t = crw.cockroach_test({
+            "workload": "bank", "nodes": ["a"], "nemesis": "parts",
+            "time_limit": 5,
+        })
+        assert t["name"] == "cockroachdb bank parts"
+        assert isinstance(t["client"], crw.BankClient)
+        assert t["accounts"] == [0, 1, 2, 3, 4]
