@@ -1,25 +1,42 @@
-"""Benchmark: the BASELINE.json north-star config.
+"""Benchmark: all five BASELINE.md configs plus an invalid-heavy lane.
 
-A 10k-op, 5-client-per-key CAS-register history (the etcd workload shape:
-~300 ops/key over ~34 independent keys, etcd.clj:167-173) checked for
-linearizability by the TPU WGL kernel, all keys in one vmapped launch.
-
-Prints ONE JSON line:
-  metric       what was measured
-  value        ops/sec checked (history length / wall time to verdict)
+Prints ONE JSON line on stdout (progress goes to stderr):
+  metric       the north-star config (10k-op CAS-register history,
+               34 independent keys, 5 clients/key — the etcd workload
+               shape, etcd.clj:167-173 — checked by the TPU WGL kernel
+               in one vmapped launch)
+  value        ops/sec checked on the north-star config
   unit         ops/s
-  vs_baseline  speedup vs the baseline target of 60 s for the same
-               history (BASELINE.md: "checked < 60 s on TPU, verdict
-               identical to knossos") — i.e. 60 / elapsed_seconds.
+  vs_baseline  60 / elapsed_seconds (BASELINE.md: "checked < 60 s on
+               TPU, verdict identical to knossos")
+  configs      per-config results for the full BASELINE matrix:
+                 1 etcd-cas-200        3 clients, 200 ops
+                 2 zk-register-2k      5 clients, 2k ops
+                 3 bank-setfull        bank totals + set-full timeline
+                 4 queue-10k-nemesis   unordered queue, 10k ops, 8%
+                                       crash (:info) completions
+                 5 stress-50k          50k-op mixed history (knossos-
+                                       intractable; unknowns expected —
+                                       steps/s is the honest metric)
+                 + invalid-heavy       16 corrupt lanes (backtracking
+                                       cost, where DFS time actually
+                                       lives)
+  cold_compile_s  XLA compile+first-launch cost for the north-star
+               shape (warm runs hit the jit cache)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import subprocess
 import sys
 import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
 def _tpu_usable(timeout: float = 45.0) -> bool:
@@ -38,69 +55,221 @@ def _tpu_usable(timeout: float = 45.0) -> bool:
         return False
 
 
-def build_history(n_keys=34, ops_per_key=300, clients_per_key=5, seed=0):
-    """Synthesize the benchmark workload: per-key concurrent histories
-    from a simulated linearizable register (the checking cost is what's
-    benchmarked; generation is host-side either way)."""
+def _helpers():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
-    from helpers import random_register_history
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    import helpers
 
+    return helpers
+
+
+def build_cas_lanes(n_keys, ops_per_key, clients_per_key, seed=0,
+                    corrupt=0.0):
+    """Per-key register histories from a simulated linearizable
+    register (the checking cost is what's benchmarked)."""
+    helpers = _helpers()
     from jepsen_tpu.history import entries as make_entries
 
     per_key = []
-    total_ops = 0
+    total = 0
     for k in range(n_keys):
-        hist = random_register_history(
+        hist = helpers.random_register_history(
             n_process=clients_per_key,
-            n_ops=ops_per_key // 2,  # n_ops counts invocations; 2 events each
+            n_ops=ops_per_key // 2,  # n_ops counts invocations
+            corrupt=corrupt,
             seed=seed + k,
         )
-        total_ops += len(hist)
+        total += len(hist)
         per_key.append(make_entries(hist))
-    return per_key, total_ops
+    return per_key, total
+
+
+def summarize(results, total_ops, elapsed) -> dict:
+    valids = [r.valid for r in results]
+    return {
+        "ops": total_ops,
+        "wall_s": round(elapsed, 3),
+        "ops_per_s": round(total_ops / elapsed, 1),
+        "verdicts": {
+            "true": sum(1 for v in valids if v is True),
+            "false": sum(1 for v in valids if v is False),
+            "unknown": sum(1 for v in valids if v == "unknown"),
+        },
+        "steps": int(sum(r.steps for r in results)),
+    }
 
 
 def main():
     use_tpu = _tpu_usable()
     if not use_tpu:
-        # TPU tunnel unavailable: fall back to CPU so the bench still
-        # reports (value reflects CPU, vs_baseline still comparable)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     import jax
 
     if not use_tpu:
         jax.config.update("jax_platforms", "cpu")
+    backend = "tpu" if use_tpu else "cpu-fallback"
+    log(f"bench backend: {backend}")
 
-    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu import checker as checker_mod
+    from jepsen_tpu.history import Op, entries as make_entries
+    from jepsen_tpu.models import CASRegister, UnorderedQueue
     from jepsen_tpu.ops import wgl_tpu
+    from jepsen_tpu.workloads import bank as bank_wl
 
-    per_key, total_ops = build_history()
+    helpers = _helpers()
+    configs = {}
+
+    def timed_batch(m, lanes, n, **kw):
+        """Warm the exact batch shape first (a new lane-count/pad/model/
+        max_steps retraces), then time the cached launch — so ops_per_s
+        measures checking, not XLA compilation."""
+        wgl_tpu.analysis_batch(m, lanes, **kw)
+        t0 = time.monotonic()
+        res = wgl_tpu.analysis_batch(m, lanes, **kw)
+        return res, summarize(res, n, time.monotonic() - t0)
+
+    # ------------------------------------------------------------------
+    # North star: 10k-op CAS history over 34 independent keys.
+    per_key, total_ops = build_cas_lanes(34, 300, 5)
     model = CASRegister()
 
-    # warm-up with the IDENTICAL batch shape + sharding so the timed run
-    # measures pure search, not XLA compilation (a different lane count
-    # would retrace)
-    wgl_tpu.analysis_batch(model, per_key)
+    t0 = time.monotonic()
+    wgl_tpu.analysis_batch(model, per_key)  # compile + first launch
+    cold = time.monotonic() - t0
+    log(f"north-star cold compile+run: {cold:.1f}s")
 
     t0 = time.monotonic()
     results = wgl_tpu.analysis_batch(model, per_key)
     elapsed = time.monotonic() - t0
-
     assert all(r.valid is True for r in results), [r.valid for r in results]
+    north_star_ops_s = total_ops / elapsed
+    log(f"north-star: {north_star_ops_s:.0f} ops/s ({elapsed:.2f}s)")
 
-    value = total_ops / elapsed
+    # ------------------------------------------------------------------
+    # Config 1: etcd CAS-register, 3 clients, 200 ops.
+    lanes, n = build_cas_lanes(1, 200, 3, seed=100)
+    res, configs["etcd-cas-200"] = timed_batch(model, lanes, n)
+    log(f"etcd-cas-200: {configs['etcd-cas-200']}")
+
+    # Config 2: zookeeper register, 5 clients, 2k ops.
+    lanes, n = build_cas_lanes(1, 2000, 5, seed=200)
+    res, configs["zk-register-2k"] = timed_batch(model, lanes, n)
+    log(f"zk-register-2k: {configs['zk-register-2k']}")
+
+    # ------------------------------------------------------------------
+    # Config 3: cockroach bank (counter totals) + set-full timeline —
+    # host-side scan checkers over synthesized histories.
+    rng = random.Random(3)
+    accounts = list(range(8))
+    balances = {a: 10 for a in accounts}
+    hist = []
+    t = 0
+    for i in range(6000):
+        p = i % 5
+        if rng.random() < 0.3:
+            hist.append(Op(p, "invoke", "read", None, time=t, index=t))
+            t += 1
+            hist.append(Op(p, "ok", "read", dict(balances), time=t, index=t))
+        else:
+            frm, to = rng.sample(accounts, 2)
+            amt = 1 + rng.randrange(5)
+            v = {"from": frm, "to": to, "amount": amt}
+            hist.append(Op(p, "invoke", "transfer", v, time=t, index=t))
+            t += 1
+            if balances[frm] - amt >= 0:
+                balances[frm] -= amt
+                balances[to] += amt
+                hist.append(Op(p, "ok", "transfer", v, time=t, index=t))
+            else:
+                hist.append(Op(p, "fail", "transfer", v, time=t, index=t))
+        t += 1
+    test_map = {"accounts": accounts, "total_amount": 80, "max_transfer": 5}
+    t0 = time.monotonic()
+    bank_res = bank_wl.checker().check(test_map, hist, {})
+    assert bank_res["valid"] is True, bank_res
+
+    sf_hist = []
+    present = []
+    t = 0
+    for i in range(5000):
+        p = i % 5
+        sf_hist.append(Op(p, "invoke", "add", i, time=t, index=t))
+        t += 1
+        present.append(i)
+        sf_hist.append(Op(p, "ok", "add", i, time=t, index=t))
+        t += 1
+        if i % 50 == 49:
+            sf_hist.append(Op(p, "invoke", "read", None, time=t, index=t))
+            t += 1
+            sf_hist.append(Op(p, "ok", "read", list(present), time=t,
+                              index=t))
+            t += 1
+    sf_res = checker_mod.set_full().check({}, sf_hist, {})
+    assert sf_res["valid"] is True, {k: sf_res[k] for k in ("valid",)}
+    wall = time.monotonic() - t0
+    configs["bank-setfull"] = {
+        "ops": len(hist) + len(sf_hist),
+        "wall_s": round(wall, 3),
+        "ops_per_s": round((len(hist) + len(sf_hist)) / wall, 1),
+        "verdicts": {"true": 2, "false": 0, "unknown": 0},
+    }
+
+    # ------------------------------------------------------------------
+    # Config 4: hazelcast-style unordered queue, 10k ops with ~8%
+    # crashed (:info) completions — the TPU queue-model kernel, sharded
+    # over 20 independent queue lanes.
+    qmodel = UnorderedQueue()
+    lanes = []
+    n = 0
+    for k in range(20):
+        h = helpers.random_queue_history(n_process=5, n_ops=250,
+                                         seed=400 + k)
+        n += len(h)
+        lanes.append(make_entries(h))
+    res, configs["queue-10k-nemesis"] = timed_batch(qmodel, lanes, n)
+    log(f"queue-10k-nemesis: {configs['queue-10k-nemesis']}")
+    assert all(r.valid is True for r in res), [r.valid for r in res]
+
+    # ------------------------------------------------------------------
+    # Config 5: 50k-op synthetic stress, one key, 10 clients —
+    # knossos-intractable; unknowns are expected and reported.
+    h = helpers.random_register_history(n_process=10, n_ops=25000,
+                                        seed=500)
+    lanes = [make_entries(h)]
+    res, configs["stress-50k"] = timed_batch(model, lanes, len(h),
+                                             max_steps=4_000_000)
+    configs["stress-50k"]["steps_per_s"] = round(
+        sum(r.steps for r in res) / configs["stress-50k"]["wall_s"], 1)
+    log(f"stress-50k: {configs['stress-50k']}")
+
+    # ------------------------------------------------------------------
+    # Invalid-heavy: 16 corrupt lanes — the expensive verdict path.
+    # Lanes are short (60 events) because refuting linearizability needs
+    # an EXHAUSTIVE search of the interleaving space (the reference
+    # truncates these artifacts because "writing these can take hours",
+    # checker.clj:138-141); long corrupt lanes step-cap to :unknown and,
+    # on the axon backend, a multi-minute device launch can trip the
+    # tunnel's op watchdog. Steps/s on the capped budget is the metric.
+    lanes, n = build_cas_lanes(16, 60, 5, seed=600, corrupt=0.2)
+    res, configs["invalid-heavy"] = timed_batch(model, lanes, n,
+                                                max_steps=200_000)
+    configs["invalid-heavy"]["steps_per_s"] = round(
+        sum(r.steps for r in res) / configs["invalid-heavy"]["wall_s"], 1)
+    assert configs["invalid-heavy"]["verdicts"]["false"] > 0
+
     print(
         json.dumps(
             {
                 "metric": "cas-register 10k-op history linearizability "
                 "check (34 keys, 5 clients/key, WGL kernel, "
-                + ("tpu" if use_tpu else "cpu-fallback")
-                + ")",
-                "value": round(value, 1),
+                + backend + ")",
+                "value": round(north_star_ops_s, 1),
                 "unit": "ops/s",
                 "vs_baseline": round(60.0 / elapsed, 1),
+                "cold_compile_s": round(cold, 1),
+                "configs": configs,
             }
         )
     )

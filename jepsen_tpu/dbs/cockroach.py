@@ -505,21 +505,10 @@ def strobe_skews() -> dict:
     }
 
 
-class _NamedFGen(gen.Generator):
-    """Wraps a nemesis's generator so emitted fs become (name, f)
-    tuples for compose routing (nemesis.clj:84-103)."""
-
-    def __init__(self, name: str, inner):
-        self.name = name
-        self.inner = gen.to_gen(inner)
-
-    def op(self, test, process):
-        op = self.inner.op(test, process)
-        if op is None:
-            return None
-        op = dict(op) if isinstance(op, dict) else op
-        op["f"] = (self.name, op["f"])
-        return op
+def _named_f_gen(name: str, inner) -> gen.Generator:
+    """Wrap a nemesis's generator so emitted fs become (name, f) tuples
+    for compose routing (nemesis.clj:84-103)."""
+    return gen.f_map(lambda f, name=name: (name, f), inner)
 
 
 class _FMap(dict):
@@ -543,9 +532,9 @@ def compose_nemeses(nemeses: list) -> dict:
         "name": "+".join(n["name"] for n in nemeses),
         "clocks": any(n.get("clocks") for n in nemeses),
         "client": nemesis.compose(routes),
-        "during": gen.mix([_NamedFGen(n["name"], n["during"])
+        "during": gen.mix([_named_f_gen(n["name"], n["during"])
                            for n in nemeses]),
-        "final": gen.concat(*[_NamedFGen(n["name"], n["final"])
+        "final": gen.concat(*[_named_f_gen(n["name"], n["final"])
                               for n in nemeses]),
     }
 

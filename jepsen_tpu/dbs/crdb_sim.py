@@ -69,7 +69,12 @@ def _parse_lit(tok: str):
         return False
     if t.startswith("'"):
         return t[1:-1]
-    return int(t)
+    try:
+        return int(t)
+    except ValueError:
+        # unsupported literal (float, bareword, ...) — a proper
+        # ErrorResponse, not a dead connection
+        raise SqlError("42601", f"can't parse literal: {t!r}") from None
 
 
 def _fmt(v) -> str | None:
