@@ -56,9 +56,11 @@ def _tpu_usable(timeout: float = 45.0) -> bool:
 
 
 def _helpers():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    for p in (os.path.dirname(os.path.abspath(__file__)),
+              os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
     import helpers
 
     return helpers
@@ -151,11 +153,13 @@ def main():
     # Config 1: etcd CAS-register, 3 clients, 200 ops.
     lanes, n = build_cas_lanes(1, 200, 3, seed=100)
     res, configs["etcd-cas-200"] = timed_batch(model, lanes, n)
+    assert all(r.valid is True for r in res), [r.valid for r in res]
     log(f"etcd-cas-200: {configs['etcd-cas-200']}")
 
     # Config 2: zookeeper register, 5 clients, 2k ops.
     lanes, n = build_cas_lanes(1, 2000, 5, seed=200)
     res, configs["zk-register-2k"] = timed_batch(model, lanes, n)
+    assert all(r.valid is True for r in res), [r.valid for r in res]
     log(f"zk-register-2k: {configs['zk-register-2k']}")
 
     # ------------------------------------------------------------------

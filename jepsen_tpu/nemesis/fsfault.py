@@ -1,0 +1,196 @@
+"""Filesystem fault-injection nemesis: compiles the native faultfs
+LD_PRELOAD interposer on each node, wraps the system under test so its
+libc I/O goes through it, then injects EIO storms on command.
+
+TPU-era equivalent of the reference's charybdefs layer
+(/root/reference/charybdefs/src/jepsen/charybdefs.clj:1-86): same
+control surface — break-all (every op fails EIO), break-one-percent
+(~1% fail), clear — but implemented as in-process interposition scoped
+to the DB's data directory instead of a thrift-driven FUSE mount, so it
+needs no kernel module, no /faulty remount, and no thrift toolchain on
+the nodes.
+
+Use:
+    fsfault.install(remote, node)              # compile libfaultfs.so
+    fsfault.wrap(remote, node, "/opt/db/bin", prefix="/opt/db/data")
+    ... start the DB through its normal daemon path ...
+    nemesis = fsfault.fs_fault_nemesis(prefix_fn)
+with nemesis ops {"f": "break-all"|"break-one-percent"|"clear"},
+or the start/stop convention: start == break (mode from the op's
+value or the nemesis default), stop == clear.
+"""
+
+from __future__ import annotations
+
+import logging
+import os.path
+
+from .. import osdist
+from ..control import Remote, RemoteError
+from ..control.util import exists
+from ..util import real_pmap
+from . import Nemesis
+
+log = logging.getLogger("jepsen_tpu.nemesis.fsfault")
+
+OPT_DIR = "/opt/jepsen"
+LIB_NAME = "libfaultfs.so"
+CTL_NAME = "faultfs.ctl"
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                           "native")
+
+
+def lib_path(opt_dir: str = OPT_DIR) -> str:
+    return f"{opt_dir}/{LIB_NAME}"
+
+
+def ctl_path(opt_dir: str = OPT_DIR) -> str:
+    return f"{opt_dir}/{CTL_NAME}"
+
+
+def compile_lib(remote: Remote, node, opt_dir: str = OPT_DIR) -> str:
+    """Upload faultfs.cpp and build the shared library on the node
+    (the charybdefs analog builds its FUSE binary on-node too,
+    charybdefs.clj:40-65)."""
+    src = os.path.join(_NATIVE_DIR, "faultfs.cpp")
+    remote.exec(node, ["mkdir", "-p", opt_dir], sudo=True)
+    remote.exec(node, ["chmod", "a+rwx", opt_dir], sudo=True)
+    remote.upload(node, src, f"{opt_dir}/faultfs.cpp")
+    remote.exec(
+        node,
+        ["g++", "-shared", "-fPIC", "-O2", "-o", LIB_NAME, "faultfs.cpp",
+         "-ldl"],
+        cd=opt_dir, sudo=True,
+    )
+    return lib_path(opt_dir)
+
+
+def install(remote: Remote, node, opt_dir: str = OPT_DIR) -> None:
+    """Build the interposer; install a compiler and retry on failure
+    (mirrors nemesis.time.install)."""
+    try:
+        compile_lib(remote, node, opt_dir)
+    except RemoteError:
+        try:
+            osdist.install(remote, node, ["build-essential"])
+        except RemoteError:
+            osdist.centos_install(remote, node, ["gcc-c++"])
+        compile_lib(remote, node, opt_dir)
+    clear(remote, node, opt_dir)
+
+
+def _write_ctl(remote: Remote, node, content: str,
+               opt_dir: str = OPT_DIR) -> None:
+    remote.exec(node, ["tee", ctl_path(opt_dir)], stdin=content, sudo=True)
+
+
+def break_all(remote: Remote, node, prefix: str = "",
+              opt_dir: str = OPT_DIR) -> None:
+    """Every intercepted I/O call fails with EIO
+    (charybdefs.clj:72-75)."""
+    _write_ctl(remote, node, f"all\n{prefix}\n", opt_dir)
+
+
+def break_percent(remote: Remote, node, pct: int = 1, prefix: str = "",
+                  opt_dir: str = OPT_DIR) -> None:
+    """~pct% of intercepted calls fail with EIO
+    (charybdefs.clj:77-80 is the 1% case)."""
+    _write_ctl(remote, node, f"percent {int(pct)}\n{prefix}\n", opt_dir)
+
+
+def clear(remote: Remote, node, opt_dir: str = OPT_DIR) -> None:
+    """Stop injecting faults (charybdefs.clj:82-85)."""
+    _write_ctl(remote, node, "off\n", opt_dir)
+
+
+def wrap(remote: Remote, node, cmd: str, prefix: str = "",
+         opt_dir: str = OPT_DIR) -> None:
+    """Replace executable `cmd` with a wrapper that launches the
+    original under LD_PRELOAD=libfaultfs.so, keeping the original at
+    cmd.no-faultfs; idempotent (the faketime.wrap pattern)."""
+    orig = f"{cmd}.no-faultfs"
+    wrapper = (
+        "#!/bin/sh\n"
+        f"export LD_PRELOAD={lib_path(opt_dir)}${{LD_PRELOAD:+:$LD_PRELOAD}}\n"
+        f"export FAULTFS_CTL={ctl_path(opt_dir)}\n"
+        f'exec {orig} "$@"\n'
+    )
+    if not exists(remote, node, orig):
+        remote.exec(node, ["mv", cmd, orig], sudo=True)
+    remote.exec(node, ["tee", cmd], stdin=wrapper, sudo=True)
+    remote.exec(node, ["chmod", "a+x", cmd], sudo=True)
+
+
+def unwrap(remote: Remote, node, cmd: str) -> None:
+    """Restore the original executable."""
+    orig = f"{cmd}.no-faultfs"
+    if exists(remote, node, orig):
+        remote.exec(node, ["mv", orig, cmd], sudo=True)
+
+
+class FsFaultNemesis(Nemesis):
+    """Drives faultfs across all nodes. Ops:
+
+        {"f": "break-all"}          every I/O call fails EIO
+        {"f": "break-one-percent"}  ~1% fail
+        {"f": "break-percent", "value": pct}
+        {"f": "clear"}              heal
+        {"f": "start"}              alias for the default break mode
+        {"f": "stop"}               alias for clear
+
+    prefix_fn(test, node) -> path scopes faults to the system under
+    test's data directory (the charybdefs /faulty mount analog)."""
+
+    def __init__(self, prefix_fn=None, default_mode: str = "break-all",
+                 opt_dir: str = OPT_DIR):
+        self.prefix_fn = prefix_fn or (lambda test, node: "")
+        self.default_mode = default_mode
+        self.opt_dir = opt_dir
+
+    def setup(self, test):
+        remote = test["remote"]
+        real_pmap(lambda n: install(remote, n, self.opt_dir),
+                  test["nodes"])
+        return self
+
+    def invoke(self, test, op):
+        remote = test["remote"]
+        f = op.f
+        if f == "start":
+            f = self.default_mode
+        if f == "stop":
+            f = "clear"
+
+        def apply(node):
+            prefix = self.prefix_fn(test, node)
+            if f == "break-all":
+                break_all(remote, node, prefix, self.opt_dir)
+            elif f == "break-one-percent":
+                break_percent(remote, node, 1, prefix, self.opt_dir)
+            elif f == "break-percent":
+                break_percent(remote, node, int(op.value), prefix,
+                              self.opt_dir)
+            elif f == "clear":
+                clear(remote, node, self.opt_dir)
+            else:
+                raise ValueError(f"fsfault can't handle {op.f!r}")
+            return f
+
+        res = dict(zip(test["nodes"],
+                       real_pmap(apply, test["nodes"])))
+        return op.with_(type="info", value=res)
+
+    def teardown(self, test):
+        remote = test["remote"]
+        for node in test["nodes"]:
+            try:
+                clear(remote, node, self.opt_dir)
+            except RemoteError:
+                log.warning("fsfault clear failed on %s", node,
+                            exc_info=True)
+
+
+def fs_fault_nemesis(prefix_fn=None,
+                     default_mode: str = "break-all") -> FsFaultNemesis:
+    return FsFaultNemesis(prefix_fn, default_mode)

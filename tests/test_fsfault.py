@@ -1,0 +1,186 @@
+"""Filesystem fault-injection tests: compile the LD_PRELOAD interposer
+through the control plane, verify EIO injection/scoping/percent modes
+against a real child process, and drive the nemesis ops end-to-end
+(reference behavior: charybdefs/src/jepsen/charybdefs.clj)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import fsfault
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ compiler"
+)
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    """One compiled interposer in a LocalRemote sandbox, shared by the
+    module (the g++ -shared build is the slow part)."""
+    tmp_path = tmp_path_factory.mktemp("fsfault")
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    opt_dir = os.path.join(remote.node_dir("n1"), "opt", "jepsen")
+    fsfault.install(remote, "n1", opt_dir=opt_dir)
+    data_dir = os.path.join(remote.node_dir("n1"), "data")
+    os.makedirs(data_dir, exist_ok=True)
+    return remote, opt_dir, data_dir
+
+
+def _io_attempt(opt_dir, path) -> bool:
+    """Try open+write+close+read under the interposer in a child
+    process; True if it all worked."""
+    code = (
+        "import sys\n"
+        f"p = {path!r}\n"
+        "try:\n"
+        "    f = open(p, 'w'); f.write('hello'); f.close()\n"
+        "    assert open(p).read() == 'hello'\n"
+        "    print('OK')\n"
+        "except OSError as e:\n"
+        "    print('ERR', e.errno)\n"
+    )
+    env = {
+        **os.environ,
+        "LD_PRELOAD": fsfault.lib_path(opt_dir),
+        "FAULTFS_CTL": fsfault.ctl_path(opt_dir),
+    }
+    out = subprocess.run(
+        ["python3", "-c", code], env=env, capture_output=True, text=True
+    )
+    return "OK" in out.stdout
+
+
+class TestInterposer:
+    def test_library_compiled(self, rig):
+        remote, opt_dir, _ = rig
+        assert os.path.exists(fsfault.lib_path(opt_dir))
+
+    def test_clear_mode_passes_io(self, rig):
+        remote, opt_dir, data_dir = rig
+        fsfault.clear(remote, "n1", opt_dir=opt_dir)
+        assert _io_attempt(opt_dir, os.path.join(data_dir, "a"))
+
+    def test_break_all_injects_eio(self, rig):
+        remote, opt_dir, data_dir = rig
+        try:
+            fsfault.break_all(remote, "n1", prefix=data_dir,
+                              opt_dir=opt_dir)
+            assert not _io_attempt(opt_dir, os.path.join(data_dir, "b"))
+        finally:
+            fsfault.clear(remote, "n1", opt_dir=opt_dir)
+
+    def test_scoping_spares_other_paths(self, rig, tmp_path):
+        remote, opt_dir, data_dir = rig
+        try:
+            fsfault.break_all(remote, "n1", prefix=data_dir,
+                              opt_dir=opt_dir)
+            assert _io_attempt(opt_dir, str(tmp_path / "outside"))
+        finally:
+            fsfault.clear(remote, "n1", opt_dir=opt_dir)
+
+    def test_percent_mode_is_probabilistic(self, rig):
+        remote, opt_dir, data_dir = rig
+        try:
+            # each attempt makes ~6 faultable libc calls, so pct=10
+            # gives ~53% pass per attempt — 20 attempts virtually
+            # guarantee a mix of passes and failures
+            fsfault.break_percent(remote, "n1", pct=10, prefix=data_dir,
+                                  opt_dir=opt_dir)
+            results = [
+                _io_attempt(opt_dir, os.path.join(data_dir, "c"))
+                for _ in range(20)
+            ]
+            # some pass, some fail — not all-or-nothing
+            assert any(results) and not all(results)
+        finally:
+            fsfault.clear(remote, "n1", opt_dir=opt_dir)
+
+    def test_recovery_after_clear(self, rig):
+        remote, opt_dir, data_dir = rig
+        fsfault.break_all(remote, "n1", prefix=data_dir, opt_dir=opt_dir)
+        fsfault.clear(remote, "n1", opt_dir=opt_dir)
+        assert _io_attempt(opt_dir, os.path.join(data_dir, "d"))
+
+
+class TestWrap:
+    def test_wrap_and_unwrap(self, rig):
+        remote, opt_dir, data_dir = rig
+        bin_path = os.path.join(remote.node_dir("n1"), "bin", "writer")
+        os.makedirs(os.path.dirname(bin_path), exist_ok=True)
+        with open(bin_path, "w") as f:
+            f.write("#!/bin/sh\necho hi > \"$1\" && cat \"$1\"\n")
+        os.chmod(bin_path, 0o755)
+
+        fsfault.wrap(remote, "n1", bin_path, prefix=data_dir,
+                     opt_dir=opt_dir)
+        assert os.path.exists(bin_path + ".no-faultfs")
+        # idempotent re-wrap keeps the original intact
+        fsfault.wrap(remote, "n1", bin_path, prefix=data_dir,
+                     opt_dir=opt_dir)
+        with open(bin_path + ".no-faultfs") as f:
+            assert "echo hi" in f.read()
+
+        target = os.path.join(data_dir, "w")
+        fsfault.clear(remote, "n1", opt_dir=opt_dir)
+        r = remote.exec("n1", [bin_path, target])
+        assert r.out.strip() == "hi"
+
+        try:
+            fsfault.break_all(remote, "n1", prefix=data_dir,
+                              opt_dir=opt_dir)
+            r = remote.exec("n1", [bin_path, target], check=False)
+            assert r.exit != 0
+        finally:
+            fsfault.clear(remote, "n1", opt_dir=opt_dir)
+
+        fsfault.unwrap(remote, "n1", bin_path)
+        assert not os.path.exists(bin_path + ".no-faultfs")
+        r = remote.exec("n1", [bin_path, target])
+        assert r.out.strip() == "hi"
+
+
+class TestNemesis:
+    def _inv(self, f, value=None):
+        return Op(process="nemesis", type="invoke", f=f, value=value)
+
+    def test_nemesis_lifecycle(self, rig):
+        remote, opt_dir, data_dir = rig
+        nem = fsfault.FsFaultNemesis(
+            prefix_fn=lambda test, node: data_dir, opt_dir=opt_dir)
+        test = {"remote": remote, "nodes": ["n1"]}
+        nem.setup(test)
+        try:
+            out = nem.invoke(test, self._inv("break-all"))
+            assert out.value == {"n1": "break-all"}
+            assert not _io_attempt(opt_dir, os.path.join(data_dir, "n"))
+
+            out = nem.invoke(test, self._inv("clear"))
+            assert out.value == {"n1": "clear"}
+            assert _io_attempt(opt_dir, os.path.join(data_dir, "n"))
+
+            out = nem.invoke(test, self._inv("break-percent", 100))
+            assert out.value == {"n1": "break-percent"}
+            assert not _io_attempt(opt_dir, os.path.join(data_dir, "n"))
+
+            # start/stop aliases
+            nem.invoke(test, self._inv("stop"))
+            assert _io_attempt(opt_dir, os.path.join(data_dir, "n"))
+            nem.invoke(test, self._inv("start"))
+            assert not _io_attempt(opt_dir, os.path.join(data_dir, "n"))
+        finally:
+            nem.teardown(test)
+        assert _io_attempt(opt_dir, os.path.join(data_dir, "n"))
+
+    def test_unknown_f_raises(self, rig):
+        remote, opt_dir, data_dir = rig
+        nem = fsfault.fs_fault_nemesis()
+        test = {"remote": remote, "nodes": ["n1"]}
+        with pytest.raises(ValueError):
+            nem.invoke(test, self._inv("detonate"))
