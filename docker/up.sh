@@ -1,0 +1,24 @@
+#!/bin/sh
+# Bring up the 5-node + control cluster (reference: docker/up.sh).
+# Generates a shared SSH keypair in ./secret on first run, builds the
+# images, and starts compose. Then:
+#     docker exec -it jepsen-control bash
+#     python -m jepsen_tpu.dbs.etcd test --node n1 ... --node n5
+set -e
+
+cd "$(dirname "$0")"
+
+if [ ! -f secret/id_rsa ]; then
+    echo "[INFO] generating cluster SSH keypair in ./secret"
+    mkdir -p secret
+    ssh-keygen -t rsa -N "" -f secret/id_rsa
+    cat > secret/config <<EOF
+Host n1 n2 n3 n4 n5
+    User root
+    IdentityFile /root/.ssh/id_rsa
+    StrictHostKeyChecking no
+    UserKnownHostsFile /dev/null
+EOF
+fi
+
+exec docker compose up --build "$@"

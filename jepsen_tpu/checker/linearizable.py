@@ -85,25 +85,51 @@ class Linearizable(Checker):
 
     def check(self, test, history, opts=None) -> dict:
         model = self._model(test)
-        es = make_entries(list(history))
+        history = list(history)  # may be a one-shot iterator; used twice
+        es = make_entries(history)
         algorithm = self.algorithm
         if algorithm == "auto":
             algorithm = "tpu" if _tpu_eligible(model, es) else "host"
 
         if algorithm == "host":
             r = wgl_host.analysis(model, es, time_limit=self.time_limit)
-            return self._result(r)
-        if algorithm == "linear":
+        elif algorithm == "linear":
             r = linear_mod.analysis(model, es, time_limit=self.time_limit)
-            return self._result(r)
-        if algorithm == "tpu":
+        elif algorithm == "tpu":
             from ..ops import wgl_tpu
 
             r = wgl_tpu.analysis(model, es, time_limit=self.time_limit)
-            return self._result(r)
-        if algorithm == "competition":
-            return self._competition(model, es)
-        raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        elif algorithm == "competition":
+            d = self._competition(model, es)
+            self._render_invalid(test, history, d, opts)
+            return d
+        else:
+            raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        d = self._result(r)
+        self._render_invalid(test, history, d, opts)
+        return d
+
+    @staticmethod
+    def _render_invalid(test, history, d, opts) -> None:
+        """On an invalid verdict, write linear.svg of the failed window
+        into the test's store dir (checker.clj:130-137)."""
+        if d.get("valid") is not False:
+            return
+        from .perf import out_path
+        from . import linear_report
+
+        path = out_path(test or {}, opts, "linear.svg")
+        if path is None:
+            return
+        try:
+            written = linear_report.render_analysis(history, d, path)
+            if written:
+                d["counterexample_svg"] = written
+        except Exception:  # noqa: BLE001 — rendering must not mask verdicts
+            import logging
+
+            logging.getLogger("jepsen_tpu.checker.linearizable").warning(
+                "linear.svg rendering failed", exc_info=True)
 
     def _competition(self, model, es) -> dict:
         """Race two genuinely different algorithms — just-in-time

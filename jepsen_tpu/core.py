@@ -495,6 +495,60 @@ def snarf_logs(test) -> None:
     real_pmap(snarf, test["nodes"])
 
 
+class _SnarfHook:
+    """Crash-time log collection (core.clj:132-149): the reference
+    installs a JVM shutdown hook so DB logs still download on ctrl-C.
+    Python's finally blocks already run on KeyboardInterrupt, but a
+    SIGTERM kills the process without unwinding and a crash *during*
+    cleanup can skip the snarf — so while a test runs we (a) convert
+    SIGTERM to SystemExit so finally blocks fire, and (b) register an
+    atexit backstop. snarf-once semantics keep the normal path from
+    downloading twice."""
+
+    def __init__(self, test):
+        self.test = test
+        self._done = False
+        self._lock = threading.Lock()
+        self._prev_sigterm = None
+
+    def snarf_once(self) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        try:
+            snarf_logs(self.test)
+        except Exception:  # noqa: BLE001
+            log.warning("log snarfing failed", exc_info=True)
+
+    def __enter__(self):
+        import atexit
+        import signal
+
+        def on_term(signum, frame):
+            raise SystemExit(143)
+
+        atexit.register(self.snarf_once)
+        if threading.current_thread() is threading.main_thread():
+            try:
+                self._prev_sigterm = signal.signal(signal.SIGTERM, on_term)
+            except ValueError:
+                self._prev_sigterm = None
+        return self
+
+    def __exit__(self, *exc):
+        import atexit
+        import signal
+
+        atexit.unregister(self.snarf_once)
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+        return False
+
+
 def analyze(test) -> dict:
     """Index the history, run the checker, persist results
     (core.clj:506-523)."""
@@ -554,23 +608,21 @@ def run(test: dict) -> dict:
                 # DB cycle (teardown -> setup, with retries)
                 if test.get("db") is not None:
                     db_mod.cycle(test)
-                try:
-                    with with_relative_time():
-                        test["history"] = index(run_case(test))
-                    log.info("Run complete, writing")
-                    if store is not None and test.get("name"):
-                        store.save_1(test)
-                    analyze(test)
-                finally:
+                with _SnarfHook(test) as hook:
                     try:
-                        snarf_logs(test)
-                    except Exception:  # noqa: BLE001
-                        log.warning("log snarfing failed", exc_info=True)
-                    if test.get("db") is not None:
-                        control.on_nodes(
-                            test,
-                            lambda t, n: test["db"].teardown(t, n),
-                        )
+                        with with_relative_time():
+                            test["history"] = index(run_case(test))
+                        log.info("Run complete, writing")
+                        if store is not None and test.get("name"):
+                            store.save_1(test)
+                        analyze(test)
+                    finally:
+                        hook.snarf_once()
+                        if test.get("db") is not None:
+                            control.on_nodes(
+                                test,
+                                lambda t, n: test["db"].teardown(t, n),
+                            )
             finally:
                 if osys is not None:
                     control.on_nodes(test, osys.teardown)

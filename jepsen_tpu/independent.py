@@ -19,7 +19,7 @@ from typing import Callable, Iterable, NamedTuple
 from . import generator as gen
 from .checker import Checker, check_safe, merge_valid
 from .history import op as to_op
-from .util import bounded_pmap
+from .util import bounded_pmap, bounded_pmap_processes
 
 DIR = "independent"
 
@@ -189,10 +189,20 @@ def subhistory(k, history) -> list:
 class IndependentChecker(Checker):
     """Lift a checker over v to one over [k v] tuples: check each key's
     subhistory (in parallel), merge validities, list failing keys
-    (independent.clj:247-298)."""
+    (independent.clj:247-298).
 
-    def __init__(self, checker: Checker):
+    processes=True fans the per-key checks over a process pool instead
+    of threads — the pure-Python search fallbacks (host WGL, the linear
+    engine) are CPU-bound, so the default thread pool serializes them
+    behind the GIL (the reference's bounded-pmap runs on a JVM where
+    threads really run in parallel, independent.clj:269-287). The
+    process path ships each worker only the picklable slice of the test
+    map; file-writing sub-checkers still run fine because artifact
+    paths derive from test name/start_time, which are plain strings."""
+
+    def __init__(self, checker: Checker, processes: bool = False):
         self.checker = checker
+        self.processes = processes
 
     def check(self, test, history, opts=None) -> dict:
         opts = dict(opts or {})
@@ -211,7 +221,33 @@ class IndependentChecker(Checker):
             self._write_artifacts(test, subdir, sub, r)
             return k, r
 
-        results = dict(bounded_pmap(check_key, ks))
+        if self.processes and len(ks) > 1:
+            # workers only use their own subhistory — shipping the full
+            # test history (or other recorded bulk) to every worker
+            # would serialize O(keys × |history|)
+            lite = _picklable_map({
+                k: v for k, v in (test or {}).items()
+                if k not in ("history", "active_histories")
+            })
+            payloads = []
+            for k in ks:
+                sub = subhistory(k, history)
+                subdir = (list(opts.get("subdirectory") or [])
+                          + [DIR, str(k)])
+                payloads.append((
+                    self.checker, lite, sub,
+                    {**_picklable_map(opts), "subdirectory": subdir,
+                     "history_key": k},
+                    k,
+                ))
+            pairs = bounded_pmap_processes(_check_payload, payloads)
+            results = {}
+            for (k, r), payload in zip(pairs, payloads):
+                self._write_artifacts(test, payload[3]["subdirectory"],
+                                      payload[2], r)
+                results[k] = r
+        else:
+            results = dict(bounded_pmap(check_key, ks))
         # Only definite falsifications are failures; "unknown" keys are
         # excluded, as in the reference (independent.clj:283-291, where
         # :unknown is truthy)
@@ -236,5 +272,28 @@ class IndependentChecker(Checker):
             pass
 
 
-def checker(c: Checker) -> IndependentChecker:
-    return IndependentChecker(c)
+def _picklable_map(m: dict) -> dict:
+    """The subset of a dict whose values survive pickling — what a
+    process-pool worker can receive (clients, remotes, generators, and
+    live sockets don't; names, models, and options do)."""
+    import pickle
+
+    out = {}
+    for k, v in m.items():
+        try:
+            pickle.dumps(v)
+        except Exception:  # noqa: BLE001 — unpicklable: drop
+            continue
+        out[k] = v
+    return out
+
+
+def _check_payload(payload):
+    """Process-pool worker: run one key's check (module-level so it
+    pickles)."""
+    chk, test, sub, opts, k = payload
+    return k, check_safe(chk, test, sub, opts)
+
+
+def checker(c: Checker, processes: bool = False) -> IndependentChecker:
+    return IndependentChecker(c, processes=processes)

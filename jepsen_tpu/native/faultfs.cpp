@@ -166,10 +166,16 @@ bool fault_for_path(const char *path, bool *in_scope) {
   return fault;
 }
 
-// Decide a fault for an op on a tracked fd.
+// Decide a fault for an op on a tracked fd. The untracked case — every
+// socket, pipe, and out-of-scope file in the process — must not pay for
+// the mutex or control-file refresh, or the interposer would serialize
+// the DB's whole I/O hot path and distort the concurrency under test:
+// a racy unlocked peek at tracked[] is safe because entries only flip
+// on open/close of that same fd (which the caller orders anyway).
 bool fault_for_fd(int fd) {
   if (fd < 0 || fd >= kMaxFds) return false;
   State *s = state();
+  if (!__atomic_load_n(&s->tracked[fd], __ATOMIC_RELAXED)) return false;
   pthread_mutex_lock(&s->mu);
   refresh_locked(s);
   bool fault = false;
@@ -186,9 +192,7 @@ bool fault_for_fd(int fd) {
 void track_fd(int fd, bool on) {
   if (fd < 0 || fd >= kMaxFds) return;
   State *s = state();
-  pthread_mutex_lock(&s->mu);
-  s->tracked[fd] = on;
-  pthread_mutex_unlock(&s->mu);
+  __atomic_store_n(&s->tracked[fd], on, __ATOMIC_RELAXED);
 }
 
 }  // namespace

@@ -82,7 +82,13 @@ def install(remote: Remote, node, opt_dir: str = OPT_DIR) -> None:
 
 def _write_ctl(remote: Remote, node, content: str,
                opt_dir: str = OPT_DIR) -> None:
-    remote.exec(node, ["tee", ctl_path(opt_dir)], stdin=content, sudo=True)
+    """Atomic control-file handoff: the interposer re-reads the file
+    every 100 ms, and a reader racing a plain truncate-and-write could
+    see 'all' with no scope line — i.e. fault EVERYTHING for a beat.
+    tee to a temp path, then rename."""
+    tmp = ctl_path(opt_dir) + ".tmp"
+    remote.exec(node, ["tee", tmp], stdin=content, sudo=True)
+    remote.exec(node, ["mv", "-f", tmp, ctl_path(opt_dir)], sudo=True)
 
 
 def break_all(remote: Remote, node, prefix: str = "",

@@ -148,3 +148,46 @@ class IPTables(Net):
 
 
 iptables = IPTables()
+
+
+class IPFilter(IPTables):
+    """ipfilter-based partition control for SmartOS/illumos nodes
+    (net.clj:111-143): block rules fed to `ipf -f -`, flush with
+    `ipf -Fa`. slow/flaky/fast are inherited from IPTables — the
+    reference's ipfilter impl issues the identical tc/netem commands
+    (net.clj:121-142), a quirk kept for parity (they only work where
+    tc exists)."""
+
+    @staticmethod
+    def _exec_in(test, node, cmd, stdin=None):
+        return test["remote"].exec(node, cmd, sudo=True, stdin=stdin)
+
+    def drop(self, test, src, dest):
+        from .control import net as cnet
+
+        rule = f"block in from {cnet.ip(test, src)} to any\n"
+        self._exec_in(test, dest, ["ipf", "-f", "-"], stdin=rule)
+
+    def drop_all(self, test, grudge):
+        def apply_one(item):
+            node, banned = item
+            if not banned:
+                return
+            from .control import net as cnet
+
+            rules = "".join(
+                f"block in from {cnet.ip(test, other)} to any\n"
+                for other in sorted(banned)
+            )
+            self._exec_in(test, node, ["ipf", "-f", "-"], stdin=rules)
+
+        real_pmap(apply_one, list(grudge.items()))
+
+    def heal(self, test):
+        real_pmap(
+            lambda node: self._exec_in(test, node, ["ipf", "-Fa"]),
+            test["nodes"],
+        )
+
+
+ipfilter = IPFilter()

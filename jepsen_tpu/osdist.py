@@ -255,3 +255,121 @@ class CentOS(OS):
 
 
 centos = CentOS()
+
+
+# ---------------------------------------------------------------------------
+# SmartOS (os/smartos.clj:1-132): pkgin package management, loopback
+# hostfile entry, ipfilter service.
+
+
+def smartos_setup_hostfile(remote: Remote, node) -> None:
+    """Ensure /etc/hosts' loopback line mentions the local hostname
+    (os/smartos.clj:12-25)."""
+    name = remote.exec(node, ["hostname"]).out.strip()
+    hosts = remote.exec(node, ["cat", "/etc/hosts"]).out
+    lines = []
+    for line in hosts.splitlines():
+        if line.startswith("127.0.0.1\t") and name not in line:
+            line = f"{line} {name}"
+        lines.append(line)
+    remote.exec(node, ["tee", "/etc/hosts"], stdin="\n".join(lines) + "\n",
+                sudo=True)
+
+
+def smartos_time_since_last_update(remote: Remote, node) -> int:
+    """Seconds since the last pkgin update (os/smartos.clj:27-31)."""
+    now = int(remote.exec(node, ["date", "+%s"]).out.strip())
+    then = int(remote.exec(
+        node, ["stat", "-c", "%Y", "/var/db/pkgin/sql.log"]).out.strip())
+    return now - then
+
+
+def smartos_update(remote: Remote, node) -> None:
+    remote.exec(node, ["pkgin", "update"], sudo=True)
+
+
+def smartos_maybe_update(remote: Remote, node) -> None:
+    """pkgin update if we haven't in a day (os/smartos.clj:37-43)."""
+    try:
+        if smartos_time_since_last_update(remote, node) > 86400:
+            smartos_update(remote, node)
+    except Exception:  # noqa: BLE001 — missing sql.log etc.
+        smartos_update(remote, node)
+
+
+def _pkgin_list(remote: Remote, node) -> dict:
+    """{package-name: version} from `pkgin -p list` lines like
+    "name-1.2.3;..." (os/smartos.clj:45-57,72-84)."""
+    out = {}
+    listing = remote.exec(node, ["pkgin", "-p", "list"]).out
+    for line in listing.splitlines():
+        full = line.split(";", 1)[0].strip()
+        if not full or "-" not in full:
+            continue
+        name_part, _, version = full.rpartition("-")
+        if name_part:
+            out[name_part] = version
+    return out
+
+
+def smartos_installed(remote: Remote, node, pkgs) -> set:
+    pkgs = {str(p) for p in pkgs}
+    return pkgs & set(_pkgin_list(remote, node))
+
+
+def smartos_installed_version(remote: Remote, node, pkg) -> str | None:
+    return _pkgin_list(remote, node).get(str(pkg))
+
+
+def smartos_uninstall(remote: Remote, node, pkgs) -> None:
+    present = smartos_installed(remote, node, pkgs)
+    if present:
+        remote.exec(node, ["pkgin", "-y", "remove", *sorted(present)],
+                    sudo=True)
+
+
+def smartos_install(remote: Remote, node, pkgs) -> None:
+    """Ensure packages are installed; a dict pins versions
+    (os/smartos.clj:86-105)."""
+    if isinstance(pkgs, dict):
+        for pkg, version in pkgs.items():
+            if smartos_installed_version(remote, node, pkg) != version:
+                log.info("Installing %s %s", pkg, version)
+                remote.exec(
+                    node, ["pkgin", "-y", "install", f"{pkg}-{version}"],
+                    sudo=True,
+                )
+        return
+    pkgs = {str(p) for p in pkgs}
+    missing = pkgs - smartos_installed(remote, node, pkgs)
+    if missing:
+        log.info("Installing %s", sorted(missing))
+        remote.exec(node, ["pkgin", "-y", "install", *sorted(missing)],
+                    sudo=True)
+
+
+class SmartOS(OS):
+    """SmartOS provisioning via pkgin; enables the ipfilter service the
+    ipfilter Net impl depends on (os/smartos.clj:107-132)."""
+
+    PACKAGES = ["wget", "curl", "vim", "unzip", "rsyslog", "logrotate"]
+
+    def setup(self, test, node) -> None:
+        log.info("%s setting up smartos", node)
+        remote = test["remote"]
+        smartos_setup_hostfile(remote, node)
+        smartos_maybe_update(remote, node)
+        smartos_install(remote, node, self.PACKAGES)
+        remote.exec(node, ["svcadm", "enable", "-r", "ipfilter"], sudo=True)
+        try:
+            net = test.get("net")
+            if net is not None:
+                net.heal(test)
+        except Exception:  # noqa: BLE001
+            log.warning("net heal failed during OS setup", exc_info=True)
+
+    def teardown(self, test, node) -> None:
+        pass
+
+
+smartos = SmartOS()

@@ -72,6 +72,32 @@ def bounded_pmap(fn: Callable, coll: Iterable, bound: int | None = None) -> list
         return list(ex.map(fn, items))
 
 
+def bounded_pmap_processes(fn: Callable, coll: Iterable,
+                           bound: int | None = None) -> list:
+    """Like bounded_pmap but over a PROCESS pool, for CPU-bound work the
+    GIL would serialize (the pure-Python linearizability searches). fn
+    and every item must be picklable. Falls back to the thread pool when
+    process workers can't start (e.g. restricted sandboxes)."""
+    items = list(coll)
+    if not items:
+        return []
+    import os
+
+    bound = min(bound or (os.cpu_count() or 1), len(items)) or 1
+    import pickle
+    from concurrent.futures.process import BrokenProcessPool
+
+    try:
+        with concurrent.futures.ProcessPoolExecutor(max_workers=bound) as ex:
+            return list(ex.map(fn, items))
+    except (OSError, PermissionError, pickle.PicklingError, TypeError,
+            AttributeError, BrokenProcessPool):
+        # can't start workers or can't pickle the payloads (e.g. a
+        # checker holding a lock, or spawn-start platforms): degrade to
+        # threads instead of voiding the whole analysis
+        return bounded_pmap(fn, items, bound=bound)
+
+
 class RetryError(Exception):
     pass
 

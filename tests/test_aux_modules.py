@@ -1,0 +1,218 @@
+"""Tests for the auxiliary modules: codec, report, repl, SmartOS
+provisioning, the ipfilter Net, process-pool independent checking, and
+the crash-time snarf hook (reference behaviors: codec.clj, report.clj,
+repl.clj, os/smartos.clj, net.clj:111-143, core.clj:132-149)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import codec, core, independent, models, net, osdist, repl
+from jepsen_tpu import report
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.history import Op
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        for v in (0, 42, "hi", [1, 2, 3], {"a": 1}, True, 3.5):
+            assert codec.decode(codec.encode(v)) == v
+
+    def test_none_is_empty_bytes(self):
+        assert codec.encode(None) == b""
+        assert codec.decode(b"") is None
+        assert codec.decode(None) is None
+
+    def test_decode_accepts_str_and_memoryview(self):
+        assert codec.decode("[1, 2]") == [1, 2]
+        assert codec.decode(memoryview(b"7")) == 7
+
+
+class TestReport:
+    def test_to_redirects_stdout(self, tmp_path):
+        path = str(tmp_path / "sub" / "report.txt")
+        with report.to(path):
+            print("hello report")
+        assert open(path).read() == "hello report\n"
+
+
+class TestRepl:
+    def test_last_test_loads_most_recent(self, tmp_path):
+        from jepsen_tpu import store
+
+        for t in ("20260101T000000.000", "20260201T000000.000"):
+            d = os.path.join(str(tmp_path), "mytest", t)
+            os.makedirs(d)
+            import json
+
+            with open(os.path.join(d, "test.json"), "w") as f:
+                json.dump({"name": "mytest", "start_time": t}, f)
+            open(os.path.join(d, "history.jsonl"), "w").close()
+        loaded = repl.last_test("mytest", store_dir=str(tmp_path))
+        assert loaded["start_time"] == "20260201T000000.000"
+
+    def test_last_test_missing_returns_none(self, tmp_path):
+        assert repl.last_test("ghost", store_dir=str(tmp_path)) is None
+
+
+class TestSmartOS:
+    def test_setup_command_stream(self):
+        remote = DummyRemote()
+        test = {"remote": remote, "nodes": ["n1"], "net": None}
+        osdist.smartos.setup(test, "n1")
+        cmds = " ; ".join(c for _, c in remote.commands)
+        assert "pkgin" in cmds
+        assert "svcadm enable -r ipfilter" in cmds
+
+    def test_install_skips_installed(self):
+        remote = DummyRemote()
+        # DummyRemote returns empty pkgin output -> everything missing
+        osdist.smartos_install(remote, "n1", ["wget"])
+        cmds = [c for _, c in remote.commands]
+        assert any("pkgin -y install wget" in c for c in cmds)
+
+
+class TestIPFilter:
+    def _test_map(self, remote):
+        return {
+            "remote": remote,
+            "nodes": ["n1", "n2"],
+            "cockroach": {},
+        }
+
+    def test_drop_all_feeds_block_rules(self, monkeypatch):
+        from jepsen_tpu.control import net as cnet
+
+        monkeypatch.setattr(cnet, "ip", lambda test, node: f"10.0.0.{node[-1]}")
+        remote = DummyRemote()
+        test = self._test_map(remote)
+        net.ipfilter.drop_all(test, {"n1": {"n2"}})
+        cmds = [c for _, c in remote.commands]
+        assert any("ipf -f -" in c for c in cmds)
+
+    def test_heal_flushes_all(self):
+        remote = DummyRemote()
+        net.ipfilter.heal(self._test_map(remote))
+        cmds = [c for n, c in remote.commands]
+        assert sum("ipf -Fa" in c for c in cmds) == 2
+
+    def test_slow_fast_use_netem(self):
+        remote = DummyRemote()
+        t = self._test_map(remote)
+        net.ipfilter.slow(t)
+        net.ipfilter.fast(t)
+        cmds = " ; ".join(c for _, c in remote.commands)
+        assert "netem delay 50ms" in cmds
+        assert "qdisc del" in cmds
+
+
+class TestProcessPoolIndependent:
+    def _history(self, n_keys=3):
+        hist = []
+        t = 0
+        for k in range(n_keys):
+            corrupt = k == 1  # key 1 is invalid
+            hist += [
+                Op(k, "invoke", "write",
+                   independent.tuple_(k, 1), time=t, index=t),
+                Op(k, "ok", "write",
+                   independent.tuple_(k, 1), time=t + 1, index=t + 1),
+                Op(k, "invoke", "read",
+                   independent.tuple_(k, None), time=t + 2, index=t + 2),
+                Op(k, "ok", "read",
+                   independent.tuple_(k, 9 if corrupt else 1),
+                   time=t + 3, index=t + 3),
+            ]
+            t += 4
+        return hist
+
+    def test_process_pool_matches_thread_pool(self):
+        test = {"model": models.CASRegister()}
+        hist = self._history()
+        threaded = independent.checker(
+            checker_mod.linearizable(algorithm="host")).check(test, hist, {})
+        pooled = independent.checker(
+            checker_mod.linearizable(algorithm="host"),
+            processes=True).check(test, hist, {})
+        assert pooled["valid"] == threaded["valid"] is False
+        assert pooled["failures"] == threaded["failures"] == [1]
+        assert set(pooled["results"]) == set(threaded["results"])
+
+    def test_unpicklable_test_entries_dropped(self):
+        import threading
+
+        test = {"model": models.CASRegister(),
+                "lock": threading.Lock()}  # unpicklable
+        res = independent.checker(
+            checker_mod.linearizable(algorithm="host"),
+            processes=True).check(test, self._history(), {})
+        assert res["valid"] is False  # still checked fine
+
+
+class TestSnarfHook:
+    def test_sigterm_still_snarfs_logs(self, tmp_path):
+        """A SIGTERM mid-run must still download DB logs
+        (core.clj:132-149's shutdown-hook behavior)."""
+        script = textwrap.dedent("""
+            import os, sys, time
+            sys.path.insert(0, %(repo)r)
+            from jepsen_tpu import checker, client, core, db as db_mod
+            from jepsen_tpu import nemesis
+            from jepsen_tpu.control import LocalRemote
+
+            class SlowDB(db_mod.DB, db_mod.LogFiles):
+                def setup(self, test, node):
+                    d = os.path.join(test["remote"].node_dir(node), "db")
+                    os.makedirs(d, exist_ok=True)
+                    with open(os.path.join(d, "db.log"), "w") as f:
+                        f.write("log line\\n")
+                def teardown(self, test, node): pass
+                def log_files(self, test, node):
+                    return [os.path.join(
+                        test["remote"].node_dir(node), "db", "db.log")]
+
+            from jepsen_tpu import generator as gen
+
+            class Hang(gen.Generator):
+                def op(self, test, process):
+                    print("RUNNING", flush=True)
+                    time.sleep(60)
+                    return None
+
+            test = {
+                "name": "sigterm-snarf",
+                "nodes": ["n1"],
+                "remote": LocalRemote(root=%(nodes)r),
+                "db": SlowDB(),
+                "client": client.noop,
+                "os": None, "net": None,
+                "concurrency": 1,
+                "store_dir": %(store)r,
+                "generator": Hang(),
+                "checker": checker.unbridled_optimism(),
+                "nemesis": nemesis.noop,
+            }
+            core.run(test)
+        """) % {"repo": "/root/repo", "nodes": str(tmp_path / "nodes"),
+                "store": str(tmp_path / "store")}
+        p = subprocess.Popen([sys.executable, "-c", script],
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+        # wait for the worker to be inside the run loop
+        line = p.stdout.readline()
+        assert "RUNNING" in line, (line, p.stderr.read())
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=30)
+        # the DB log made it into the store despite the SIGTERM
+        found = []
+        for root, dirs, files in os.walk(str(tmp_path / "store")):
+            found += [f for f in files if f.endswith("db.log")
+                      or "db_db.log" in f]
+        assert found, list(os.walk(str(tmp_path / "store")))

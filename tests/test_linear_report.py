@@ -1,0 +1,102 @@
+"""Counterexample SVG rendering tests (reference behavior:
+knossos.linear.report via checker.clj:130-137)."""
+
+from __future__ import annotations
+
+import os
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import models
+from jepsen_tpu.checker import linear_report
+from jepsen_tpu.history import Op
+
+
+def _invalid_register_history():
+    """w=0 ok, then a read of 1 — not linearizable."""
+    return [
+        Op(0, "invoke", "write", 0, time=0, index=0),
+        Op(0, "ok", "write", 0, time=1, index=1),
+        Op(1, "invoke", "read", None, time=2, index=2),
+        Op(1, "ok", "read", 1, time=3, index=3),
+    ]
+
+
+class TestRenderAnalysis:
+    def test_writes_svg_with_failure_window(self, tmp_path):
+        hist = _invalid_register_history()
+        result = checker_mod.linearizable(
+            models.CASRegister(), algorithm="host").check({}, hist, {})
+        assert result["valid"] is False
+        path = str(tmp_path / "linear.svg")
+        written = linear_report.render_analysis(hist, result, path)
+        assert written == path
+        svg = open(path).read()
+        assert svg.startswith("<svg")
+        assert "Linearizability failure window" in svg
+        assert "read 1" in svg
+        # the failing op is drawn in the failure color
+        assert linear_report.FAIL_FILL in svg
+
+    def test_deepest_linearization_numbered(self, tmp_path):
+        hist = _invalid_register_history()
+        result = checker_mod.linearizable(
+            models.CASRegister(), algorithm="host").check({}, hist, {})
+        path = str(tmp_path / "linear.svg")
+        linear_report.render_analysis(hist, result, path)
+        svg = open(path).read()
+        if result.get("final_paths"):
+            assert linear_report.LIN_STROKE in svg
+
+    def test_empty_history_returns_none(self, tmp_path):
+        assert linear_report.render_analysis(
+            [], {"valid": False}, str(tmp_path / "x.svg")) is None
+
+    def test_crashed_ops_rendered(self, tmp_path):
+        hist = [
+            Op(0, "invoke", "write", 3, time=0, index=0),
+            Op(0, "info", "write", 3, time=1, index=1),
+            Op(1, "invoke", "read", None, time=2, index=2),
+            Op(1, "ok", "read", 5, time=3, index=3),
+        ]
+        path = str(tmp_path / "linear.svg")
+        written = linear_report.render_analysis(
+            hist, {"valid": False}, path)
+        assert written and linear_report.CRASH_FILL in open(path).read()
+
+
+class TestCheckerIntegration:
+    def test_invalid_check_writes_linear_svg(self, tmp_path):
+        test = {
+            "name": "svg-test",
+            "start_time": "20260730T000000.000",
+            "model": models.CASRegister(),
+        }
+        hist = _invalid_register_history()
+        result = checker_mod.linearizable(algorithm="host").check(
+            test, hist, {})
+        assert result["valid"] is False
+        assert "counterexample_svg" in result
+        assert os.path.exists(result["counterexample_svg"])
+        assert os.path.basename(result["counterexample_svg"]) == "linear.svg"
+
+    def test_valid_check_writes_nothing(self, tmp_path):
+        test = {
+            "name": "svg-test-valid",
+            "start_time": "20260730T000000.000",
+            "model": models.CASRegister(),
+        }
+        hist = [
+            Op(0, "invoke", "write", 1, time=0, index=0),
+            Op(0, "ok", "write", 1, time=1, index=1),
+        ]
+        result = checker_mod.linearizable(algorithm="host").check(
+            test, hist, {})
+        assert result["valid"] is True
+        assert "counterexample_svg" not in result
+
+    def test_no_store_context_is_harmless(self):
+        result = checker_mod.linearizable(
+            models.CASRegister(), algorithm="host").check(
+            {}, _invalid_register_history(), {})
+        assert result["valid"] is False
+        assert "counterexample_svg" not in result
