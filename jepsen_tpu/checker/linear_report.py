@@ -41,15 +41,22 @@ def _window(history: list, failing: Op | None) -> list:
     pairs = _pairs(history)
     if failing is None:
         return pairs[-MAX_OPS:]
-    # locate the failing op's invocation position
+    # locate the failing op's pair: exact index match wins outright —
+    # a loose (process, f) match could center the window on a later
+    # unrelated op and leave the real failure outside the picture
     fail_pos = None
     for i, (inv, comp) in enumerate(pairs):
-        if (inv.process == failing.process and inv.f == failing.f
-                and (comp is None or comp.index is None
-                     or failing.index is None
-                     or comp.index == failing.index
-                     or inv.index == failing.index)):
+        if failing.index is not None and (
+            inv.index == failing.index
+            or (comp is not None and comp.index == failing.index)
+        ):
             fail_pos = i
+            break
+    if fail_pos is None:  # no index info: last (process, f, value) match
+        for i, (inv, comp) in enumerate(pairs):
+            if (inv.process == failing.process and inv.f == failing.f
+                    and inv.value == failing.value):
+                fail_pos = i
     if fail_pos is None:
         return pairs[-MAX_OPS:]
     lo = max(0, fail_pos - MAX_OPS // 2)

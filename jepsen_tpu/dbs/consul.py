@@ -1,0 +1,268 @@
+"""Consul test suite: a CAS register in one /v1/kv key (reference:
+/root/reference/consul/src/jepsen/consul.clj:1-146).
+
+Pieces, mirroring the reference:
+  - ConsulDB     — agent lifecycle: primary bootstraps, the rest join it
+                   (consul.clj:22-57); archive mode runs the in-repo sim
+                   through the same daemon machinery
+  - ConsulKV     — HTTP /v1/kv connection: base64 values,
+                   X-Consul-Index, ?cas=<ModifyIndex> check-and-set
+                   (consul.clj:66-109)
+  - CASClient    — JSON-encoded register with the reference's
+                   determinacy taxonomy: reads always :fail on error
+                   ("we can always pretend they didn't happen",
+                   consul.clj:121-125); writes/cas crash to :info
+  - consul_test  — test map; main() — CLI entry
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import logging
+import random
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from .. import checker as checker_mod
+from .. import cli, client, db, generator as gen, models, nemesis, osdist
+from ..control import util as cu
+from ..history import Op
+
+log = logging.getLogger("jepsen_tpu.dbs.consul")
+
+PORT = 8500
+KEY = "jepsen"
+
+
+def _cfg(test) -> dict:
+    return test.get("consul") or {}
+
+
+def node_host(test, node) -> str:
+    fn = _cfg(test).get("addr_fn")
+    return fn(node) if fn else str(node)
+
+
+def node_port(test, node) -> int:
+    ports = _cfg(test).get("ports")
+    return ports[node] if ports else PORT
+
+
+def node_dir(test, node) -> str:
+    d = _cfg(test).get("dir", "/opt/consul")
+    return d(node) if callable(d) else d
+
+
+class ConsulDB(db.DB, db.LogFiles):
+    """Consul agent per node (consul.clj:22-57): the first node runs
+    -bootstrap, the rest -join it."""
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 30.0):
+        self.archive_url = archive_url
+        self.ready_timeout = ready_timeout
+
+    def setup(self, test, node) -> None:
+        remote = test["remote"]
+        d = node_dir(test, node)
+        sudo = _cfg(test).get("sudo", True)
+        url = self.archive_url or _cfg(test).get("archive_url")
+        if not url:
+            raise db.SetupFailed(
+                "consul archive_url required (release zip/tarball, or "
+                "the consul_sim archive for hermetic runs)")
+        cu.install_archive(remote, node, url, d, sudo=sudo)
+        primary = test["nodes"][0]
+        extra = (["-bootstrap"] if node == primary
+                 else ["-join", node_host(test, primary)])
+        cu.start_daemon(
+            remote, node, f"{d}/consul", "agent",
+            "-server",
+            "-node", str(node),
+            "-data-dir", f"{d}/data",
+            "-client", "0.0.0.0",
+            "-http-port", str(node_port(test, node)),
+            *extra,
+            logfile=f"{d}/consul.log",
+            pidfile=f"{d}/consul.pid",
+            chdir=d,
+        )
+        self.await_ready(test, node)
+
+    def await_ready(self, test, node) -> None:
+        deadline = time.monotonic() + self.ready_timeout
+        url = (f"http://{node_host(test, node)}:{node_port(test, node)}"
+               "/v1/status/leader")
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    if resp.status == 200 and resp.read().strip() != b'""':
+                        return
+            except OSError:
+                pass
+            if time.monotonic() > deadline:
+                raise db.SetupFailed(f"consul on {node} has no leader")
+            time.sleep(0.2)
+
+    def teardown(self, test, node) -> None:
+        remote = test["remote"]
+        d = node_dir(test, node)
+        log.info("%s tearing down consul", node)
+        cu.stop_daemon(remote, node, f"{d}/consul.pid")
+        remote.exec(node, ["rm", "-rf", d],
+                    sudo=_cfg(test).get("sudo", True), check=False)
+
+    def log_files(self, test, node) -> list:
+        return [f"{node_dir(test, node)}/consul.log"]
+
+
+class ConsulKV:
+    """One node's /v1/kv endpoint (consul.clj:94-109)."""
+
+    def __init__(self, host: str, port: int, key: str = KEY,
+                 timeout: float = 5.0):
+        self.base = f"http://{host}:{port}/v1/kv/{key}"
+        self.timeout = timeout
+
+    def _request(self, method: str, url: str, data: bytes | None = None):
+        req = urllib.request.Request(url, data=data, method=method)
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def get(self):
+        """(value-bytes | None, modify-index)."""
+        try:
+            with self._request("GET", self.base) as resp:
+                body = json.load(resp)[0]
+                return (base64.b64decode(body["Value"]),
+                        int(body["ModifyIndex"]))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None, 0
+            raise
+
+    def put(self, value: bytes) -> bool:
+        with self._request("PUT", self.base, data=value) as resp:
+            return resp.read().strip() == b"true"
+
+    def cas(self, value: bytes, new_value: bytes) -> bool:
+        """Index-based CAS: read, compare the payload, then PUT with
+        ?cas=<ModifyIndex> (consul.clj:100-109)."""
+        cur, index = self.get()
+        if cur != value:
+            return False
+        url = f"{self.base}?cas={index}"
+        with self._request("PUT", url, data=new_value) as resp:
+            return resp.read().strip() == b"true"
+
+
+class CASClient(client.Client):
+    """JSON-encoded CAS register (consul.clj:111-141). Reads :fail on
+    any error; writes and cas crash to :info on indeterminate errors."""
+
+    def __init__(self, conn: ConsulKV | None = None, timeout: float = 5.0):
+        self.conn = conn
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return CASClient(
+            ConsulKV(node_host(test, node), node_port(test, node),
+                     timeout=self.timeout),
+            timeout=self.timeout,
+        )
+
+    def setup(self, test):
+        try:
+            self.conn.put(json.dumps(None).encode())
+        except OSError:
+            pass  # another client may already have seeded the key
+
+    def invoke(self, test, op: Op) -> Op:
+        crash = "fail" if op.f == "read" else "info"
+        try:
+            if op.f == "read":
+                cur, _ = self.conn.get()
+                value = json.loads(cur) if cur else None
+                return op.with_(type="ok", value=value)
+            if op.f == "write":
+                self.conn.put(json.dumps(op.value).encode())
+                return op.with_(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                ok = self.conn.cas(json.dumps(old).encode(),
+                                   json.dumps(new).encode())
+                return op.with_(type="ok" if ok else "fail")
+            raise ValueError(f"unknown op {op.f!r}")
+        except (socket.timeout, TimeoutError):
+            return op.with_(type=crash, error="timeout")
+        except (urllib.error.URLError, OSError) as e:
+            return op.with_(type=crash, error=str(e))
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randrange(5)}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": (random.randrange(5), random.randrange(5))}
+
+
+def consul_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": "consul",
+            "os": osdist.debian,
+            "db": ConsulDB(archive_url=opts.get("archive_url")),
+            "client": CASClient(),
+            "nemesis": nemesis.partition_random_halves(),
+            "model": models.CASRegister(),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "linear": checker_mod.linearizable(),
+            }),
+            "generator": gen.time_limit(
+                opts.get("time_limit", 60),
+                gen.nemesis(
+                    gen.seq(itertools.cycle([
+                        gen.sleep(5),
+                        {"type": "info", "f": "start"},
+                        gen.sleep(5),
+                        {"type": "info", "f": "stop"},
+                    ])),
+                    gen.stagger(1 / 10, gen.mix([r, w, cas])),
+                ),
+            ),
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--archive-url", dest="archive_url", default=None,
+                   help="consul release archive (or the in-repo sim "
+                        "archive for hermetic runs).")
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(consul_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

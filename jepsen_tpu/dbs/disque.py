@@ -1,0 +1,253 @@
+"""Disque test suite: at-least-once distributed job queue driven with
+enqueue/dequeue/drain ops and checked with total-queue (reference:
+/root/reference/disque/src/jepsen/disque.clj:1-321).
+
+Pieces, mirroring the reference:
+  - DisqueDB      — build-or-install + daemon lifecycle + CLUSTER MEET
+                    join to the primary (disque.clj:40-135)
+  - DisqueClient  — ADDJOB/GETJOB/ACKJOB over RESP with a
+                    reconnect-on-failure wrapper (the reference's
+                    goldfish-replacing reconnecting-client,
+                    disque.clj:163-192); dequeue acks what it takes;
+                    drain loops until a poll comes back empty
+                    (disque.clj:194-240)
+  - disque_test   — test map with partitioner nemesis and the final
+                    heal-then-drain phase; total-queue checker
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import time
+
+from .. import checker as checker_mod
+from .. import cli, client, db, generator as gen, nemesis, osdist, reconnect
+from ..control import util as cu
+from ..history import Op
+from . import redis_proto
+
+log = logging.getLogger("jepsen_tpu.dbs.disque")
+
+PORT = 7711
+QUEUE = "jepsen"
+CLIENT_TIMEOUT_MS = 100  # job poll timeout
+
+
+def _cfg(test) -> dict:
+    return test.get("disque") or {}
+
+
+def node_host(test, node) -> str:
+    fn = _cfg(test).get("addr_fn")
+    return fn(node) if fn else str(node)
+
+
+def node_port(test, node) -> int:
+    ports = _cfg(test).get("ports")
+    return ports[node] if ports else PORT
+
+
+def node_dir(test, node) -> str:
+    d = _cfg(test).get("dir", "/opt/disque")
+    return d(node) if callable(d) else d
+
+
+class DisqueDB(db.DB, db.LogFiles):
+    """disque-server per node, joined via CLUSTER MEET to the primary
+    (disque.clj:40-135). The reference builds from source on-node;
+    archive mode installs a prebuilt (or sim) tarball through the same
+    daemon machinery."""
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 30.0):
+        self.archive_url = archive_url
+        self.ready_timeout = ready_timeout
+
+    def setup(self, test, node) -> None:
+        remote = test["remote"]
+        d = node_dir(test, node)
+        sudo = _cfg(test).get("sudo", True)
+        url = self.archive_url or _cfg(test).get("archive_url")
+        if not url:
+            raise db.SetupFailed(
+                "disque archive_url required (prebuilt tarball, or the "
+                "redis_sim archive for hermetic runs)")
+        cu.install_archive(remote, node, url, d, sudo=sudo)
+        cu.start_daemon(
+            remote, node, f"{d}/disque-server",
+            "--port", str(node_port(test, node)),
+            logfile=f"{d}/disque.log",
+            pidfile=f"{d}/disque.pid",
+            chdir=d,
+        )
+        self.await_ready(test, node)
+        # join everyone to the primary (disque.clj:96-105)
+        primary = test["nodes"][0]
+        if node != primary:
+            conn = redis_proto.RespConn(
+                node_host(test, node), node_port(test, node))
+            try:
+                res = conn.call("CLUSTER", "MEET",
+                                node_host(test, primary),
+                                node_port(test, primary))
+                if res != "OK":
+                    raise db.SetupFailed(f"cluster meet said {res!r}")
+            finally:
+                conn.close()
+
+    def await_ready(self, test, node) -> None:
+        deadline = time.monotonic() + self.ready_timeout
+        while True:
+            try:
+                conn = redis_proto.RespConn(
+                    node_host(test, node), node_port(test, node),
+                    timeout=2.0)
+                try:
+                    if conn.call("PING") == "PONG":
+                        return
+                finally:
+                    conn.close()
+            except OSError:
+                pass
+            if time.monotonic() > deadline:
+                raise db.SetupFailed(f"disque on {node} never ponged")
+            time.sleep(0.2)
+
+    def teardown(self, test, node) -> None:
+        remote = test["remote"]
+        d = node_dir(test, node)
+        log.info("%s tearing down disque", node)
+        cu.stop_daemon(remote, node, f"{d}/disque.pid")
+        remote.exec(node, ["rm", "-rf", d],
+                    sudo=_cfg(test).get("sudo", True), check=False)
+
+    def log_files(self, test, node) -> list:
+        return [f"{node_dir(test, node)}/disque.log"]
+
+
+class DisqueClient(client.Client):
+    """enqueue = ADDJOB, dequeue = GETJOB+ACKJOB, drain = dequeue until
+    empty (disque.clj:194-262). An empty poll is a definite :fail; any
+    connection trouble on enqueue/dequeue is :info (the job may or may
+    not be in)."""
+
+    def __init__(self, conn=None, queue: str = QUEUE):
+        self.conn = conn
+        self.queue = queue
+
+    def open(self, test, node):
+        wrapped = reconnect.wrapper(
+            open=lambda: redis_proto.RespConn(
+                node_host(test, node), node_port(test, node)),
+            close=lambda c: c.close(),
+            name=f"disque {node}",
+        ).open()
+        return DisqueClient(wrapped, self.queue)
+
+    def _dequeue_once(self, c):
+        """(job-id, body) or None."""
+        got = c.call("GETJOB", "TIMEOUT", CLIENT_TIMEOUT_MS, "COUNT", 1,
+                     "FROM", self.queue)
+        if not got:
+            return None
+        _q, jid, body = got[0]
+        c.call("ACKJOB", jid)
+        return jid, body
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            with self.conn.with_conn() as c:
+                if op.f == "enqueue":
+                    c.call("ADDJOB", self.queue, str(op.value), 100)
+                    return op.with_(type="ok")
+                if op.f == "dequeue":
+                    got = self._dequeue_once(c)
+                    if got is None:
+                        return op.with_(type="fail", error="empty")
+                    return op.with_(type="ok", value=int(got[1].decode()))
+                if op.f == "drain":
+                    values = []
+                    deadline = time.monotonic() + 10.0
+                    while time.monotonic() < deadline:
+                        got = self._dequeue_once(c)
+                        if got is None:
+                            return op.with_(type="ok", value=values)
+                        values.append(int(got[1].decode()))
+                    return op.with_(type="info", error="drain-timeout",
+                                    value=values)
+                raise ValueError(f"unknown op {op.f!r}")
+        except redis_proto.RespError as e:
+            return op.with_(type="info", error=str(e))
+        except (socket.timeout, TimeoutError):
+            return op.with_(type="info", error="timeout")
+        except (ConnectionError, OSError) as e:
+            return op.with_(type="info", error=str(e))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def queue_gen() -> gen.Generator:
+    counter = itertools.count()
+
+    def enqueue(test, process):
+        return {"type": "invoke", "f": "enqueue", "value": next(counter)}
+
+    return gen.mix([enqueue, {"type": "invoke", "f": "dequeue"}])
+
+
+def disque_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": "disque",
+            "os": osdist.debian,
+            "db": DisqueDB(archive_url=opts.get("archive_url")),
+            "client": DisqueClient(),
+            "nemesis": nemesis.partition_random_halves(),
+            "generator": gen.phases(
+                gen.time_limit(
+                    opts.get("time_limit", 60),
+                    gen.nemesis(
+                        gen.start_stop(10, 10),
+                        gen.stagger(opts.get("stagger", 1 / 10),
+                                    queue_gen()),
+                    ),
+                ),
+                gen.log("Healing cluster"),
+                gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+                gen.sleep(opts.get("quiesce", 10)),
+                gen.clients(gen.each(
+                    lambda: gen.once({"type": "invoke", "f": "drain"}))),
+            ),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "queue": checker_mod.total_queue(),
+            }),
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--archive-url", dest="archive_url", default=None,
+                   help="disque release archive (or the in-repo sim "
+                        "archive for hermetic runs).")
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(disque_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

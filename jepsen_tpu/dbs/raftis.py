@@ -1,0 +1,223 @@
+"""Raftis test suite: a raft-replicated redis register driven with
+read/write ops over RESP (reference:
+/root/reference/raftis/src/jepsen/raftis.clj:1-138).
+
+Pieces, mirroring the reference:
+  - RaftisDB     — archive install + daemon with an initial-cluster
+                   string "host:8901,..." (raftis.clj:61-105)
+  - RaftisClient — GET/SET on key "r" with the reference's error
+                   taxonomy (raftis.clj:36-57): reads always :fail;
+                   "no leader" and "socket closed" writes :fail (the
+                   write was rejected/never sent); other write errors
+                   and timeouts :info
+  - raftis_test  — register workload, partition nemesis, linearizable
+                   checker (raftis.clj:107-130)
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+import socket
+import time
+
+from .. import checker as checker_mod
+from .. import cli, client, db, generator as gen, models, nemesis, osdist
+from ..control import util as cu
+from ..history import Op
+from . import redis_proto
+
+log = logging.getLogger("jepsen_tpu.dbs.raftis")
+
+PORT = 6379
+RAFT_PORT = 8901
+KEY = "r"
+
+
+def _cfg(test) -> dict:
+    return test.get("raftis") or {}
+
+
+def node_host(test, node) -> str:
+    fn = _cfg(test).get("addr_fn")
+    return fn(node) if fn else str(node)
+
+
+def node_port(test, node) -> int:
+    ports = _cfg(test).get("ports")
+    return ports[node] if ports else PORT
+
+
+def node_dir(test, node) -> str:
+    d = _cfg(test).get("dir", "/opt/raftis")
+    return d(node) if callable(d) else d
+
+
+def initial_cluster(test) -> str:
+    """host:8901,host:8901,... (raftis.clj:68-74)."""
+    return ",".join(
+        f"{node_host(test, n)}:{RAFT_PORT}" for n in test["nodes"]
+    )
+
+
+class RaftisDB(db.DB, db.LogFiles):
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 30.0):
+        self.archive_url = archive_url
+        self.ready_timeout = ready_timeout
+
+    def setup(self, test, node) -> None:
+        remote = test["remote"]
+        d = node_dir(test, node)
+        sudo = _cfg(test).get("sudo", True)
+        url = self.archive_url or _cfg(test).get("archive_url")
+        if not url:
+            raise db.SetupFailed(
+                "raftis archive_url required (binary tarball, or the "
+                "redis_sim archive for hermetic runs)")
+        cu.install_archive(remote, node, url, d, sudo=sudo)
+        cu.start_daemon(
+            remote, node, f"{d}/raftis",
+            "--port", str(node_port(test, node)),
+            "--cluster", initial_cluster(test),
+            logfile=f"{d}/raftis.log",
+            pidfile=f"{d}/raftis.pid",
+            chdir=d,
+        )
+        self.await_ready(test, node)
+
+    def await_ready(self, test, node) -> None:
+        deadline = time.monotonic() + self.ready_timeout
+        while True:
+            try:
+                conn = redis_proto.RespConn(
+                    node_host(test, node), node_port(test, node),
+                    timeout=2.0)
+                try:
+                    if conn.call("PING") == "PONG":
+                        return
+                finally:
+                    conn.close()
+            except OSError:
+                pass
+            if time.monotonic() > deadline:
+                raise db.SetupFailed(f"raftis on {node} never ponged")
+            time.sleep(0.2)
+
+    def teardown(self, test, node) -> None:
+        remote = test["remote"]
+        d = node_dir(test, node)
+        log.info("%s tearing down raftis", node)
+        cu.stop_daemon(remote, node, f"{d}/raftis.pid")
+        remote.exec(node, ["rm", "-rf", d],
+                    sudo=_cfg(test).get("sudo", True), check=False)
+
+    def log_files(self, test, node) -> list:
+        return [f"{node_dir(test, node)}/raftis.log"]
+
+
+class RaftisClient(client.Client):
+    """GET/SET register with raftis.clj:44-57's taxonomy."""
+
+    def __init__(self, conn: redis_proto.RespConn | None = None,
+                 timeout: float = 5.0):
+        self.conn = conn
+        self.timeout = timeout
+
+    def open(self, test, node):
+        conn = redis_proto.RespConn(
+            node_host(test, node), node_port(test, node),
+            timeout=self.timeout)
+        return RaftisClient(conn, timeout=self.timeout)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                raw = self.conn.call("GET", KEY)
+                value = int(raw) if raw is not None else None
+                return op.with_(type="ok", value=value)
+            if op.f == "write":
+                self.conn.call("SET", KEY, op.value)
+                return op.with_(type="ok")
+            raise ValueError(f"unknown op {op.f!r}")
+        except redis_proto.RespError as e:
+            # "no leader" means the write was rejected — definite fail
+            # (raftis.clj:46-49)
+            if op.f == "read" or "no leader" in str(e):
+                return op.with_(type="fail", error=str(e))
+            return op.with_(type="info", error=str(e))
+        except (socket.timeout, TimeoutError):
+            return op.with_(
+                type="fail" if op.f == "read" else "info", error="timeout")
+        except ConnectionError as e:
+            # socket closed: the reference treats this as :fail too
+            return op.with_(type="fail", error=str(e))
+        except OSError as e:
+            return op.with_(
+                type="fail" if op.f == "read" else "info", error=str(e))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randrange(5)}
+
+
+def raftis_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": "raftis",
+            "os": osdist.debian,
+            "db": RaftisDB(archive_url=opts.get("archive_url")),
+            "client": RaftisClient(),
+            "nemesis": nemesis.partition_random_halves(),
+            "model": models.Register(),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "timeline": checker_mod.timeline_html(),
+                "linear": checker_mod.linearizable(),
+            }),
+            "generator": gen.time_limit(
+                opts.get("time_limit", 60),
+                gen.nemesis(
+                    gen.seq(itertools.cycle([
+                        gen.sleep(5),
+                        {"type": "info", "f": "start"},
+                        gen.sleep(5),
+                        {"type": "info", "f": "stop"},
+                    ])),
+                    gen.stagger(1 / 10, gen.mix([r, w])),
+                ),
+            ),
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--archive-url", dest="archive_url", default=None,
+                   help="raftis release archive (or the in-repo sim "
+                        "archive for hermetic runs).")
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(raftis_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

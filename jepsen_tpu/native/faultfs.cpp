@@ -175,7 +175,7 @@ bool fault_for_path(const char *path, bool *in_scope) {
 bool fault_for_fd(int fd) {
   if (fd < 0 || fd >= kMaxFds) return false;
   State *s = state();
-  if (!__atomic_load_n(&s->tracked[fd], __ATOMIC_RELAXED)) return false;
+  if (!__atomic_load_n(&s->tracked[fd], __ATOMIC_ACQUIRE)) return false;
   pthread_mutex_lock(&s->mu);
   refresh_locked(s);
   bool fault = false;
@@ -192,7 +192,13 @@ bool fault_for_fd(int fd) {
 void track_fd(int fd, bool on) {
   if (fd < 0 || fd >= kMaxFds) return;
   State *s = state();
-  __atomic_store_n(&s->tracked[fd], on, __ATOMIC_RELAXED);
+  // cold path (open/close): keep the mutex so untracking an fd
+  // happens-before any other thread's use of a recycled fd number —
+  // a plain relaxed store could leak a stale 'tracked' into an
+  // innocent socket that reuses the fd
+  pthread_mutex_lock(&s->mu);
+  __atomic_store_n(&s->tracked[fd], on, __ATOMIC_RELEASE);
+  pthread_mutex_unlock(&s->mu);
 }
 
 }  // namespace

@@ -264,16 +264,10 @@ centos = CentOS()
 
 def smartos_setup_hostfile(remote: Remote, node) -> None:
     """Ensure /etc/hosts' loopback line mentions the local hostname
-    (os/smartos.clj:12-25)."""
-    name = remote.exec(node, ["hostname"]).out.strip()
-    hosts = remote.exec(node, ["cat", "/etc/hosts"]).out
-    lines = []
-    for line in hosts.splitlines():
-        if line.startswith("127.0.0.1\t") and name not in line:
-            line = f"{line} {name}"
-        lines.append(line)
-    remote.exec(node, ["tee", "/etc/hosts"], stdin="\n".join(lines) + "\n",
-                sudo=True)
+    (os/smartos.clj:12-25) — same append-hostname behavior as CentOS,
+    so reuse it (the centos variant matches both tab- and
+    space-separated loopback lines)."""
+    centos_setup_hostfile(remote, node)
 
 
 def smartos_time_since_last_update(remote: Remote, node) -> int:
@@ -332,8 +326,9 @@ def smartos_install(remote: Remote, node, pkgs) -> None:
     """Ensure packages are installed; a dict pins versions
     (os/smartos.clj:86-105)."""
     if isinstance(pkgs, dict):
+        versions = _pkgin_list(remote, node)  # one listing for all pins
         for pkg, version in pkgs.items():
-            if smartos_installed_version(remote, node, pkg) != version:
+            if versions.get(str(pkg)) != version:
                 log.info("Installing %s %s", pkg, version)
                 remote.exec(
                     node, ["pkgin", "-y", "install", f"{pkg}-{version}"],

@@ -1,0 +1,268 @@
+"""Suite tests for consul (HTTP KV index-CAS), disque (RESP job
+queue), and raftis (RESP register): sim semantics, client taxonomy, DB
+lifecycle, and full engine runs (reference behaviors: consul.clj,
+disque.clj, raftis.clj)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import core, generator as gen, models, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import consul, consul_sim, disque, raftis
+from jepsen_tpu.dbs import redis_proto, redis_sim
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+# ---------------------------------------------------------------------------
+# Consul
+
+
+@pytest.fixture
+def consul_port(tmp_path):
+    class H(consul_sim.Handler):
+        store = consul_sim.Store(str(tmp_path / "consul.json"))
+        mean_latency = 0.0
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestConsulKV:
+    def test_missing_key(self, consul_port):
+        kv = consul.ConsulKV("127.0.0.1", consul_port)
+        assert kv.get() == (None, 0)
+
+    def test_put_get_roundtrip(self, consul_port):
+        kv = consul.ConsulKV("127.0.0.1", consul_port)
+        assert kv.put(b"3") is True
+        value, index = kv.get()
+        assert value == b"3" and index >= 1
+
+    def test_index_cas(self, consul_port):
+        kv = consul.ConsulKV("127.0.0.1", consul_port)
+        kv.put(b"1")
+        assert kv.cas(b"1", b"2") is True
+        assert kv.get()[0] == b"2"
+        assert kv.cas(b"1", b"3") is False  # wrong current value
+        assert kv.get()[0] == b"2"
+
+    def test_stale_index_cas_fails(self, consul_port):
+        kv = consul.ConsulKV("127.0.0.1", consul_port)
+        kv.put(b"1")
+        _, index = kv.get()
+        kv.put(b"1")  # bumps ModifyIndex, value unchanged
+        import urllib.request
+
+        url = f"{kv.base}?cas={index}"
+        req = urllib.request.Request(url, data=b"9", method="PUT")
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            assert resp.read().strip() == b"false"
+
+    def test_client_taxonomy(self, consul_port):
+        t = {"consul": {"addr_fn": lambda n: "127.0.0.1",
+                        "ports": {"n1": consul_port}}}
+        c = consul.CASClient().open(t, "n1")
+        c.setup(t)
+        w = c.invoke(t, Op(0, "invoke", "write", 4))
+        assert w.type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r.type == "ok" and r.value == 4
+        good = c.invoke(t, Op(0, "invoke", "cas", (4, 2)))
+        assert good.type == "ok"
+        bad = c.invoke(t, Op(0, "invoke", "cas", (4, 9)))
+        assert bad.type == "fail"
+
+    def test_dead_node_read_fails_write_crashes(self):
+        t = {"consul": {"addr_fn": lambda n: "127.0.0.1",
+                        "ports": {"n1": free_port()}}}
+        c = consul.CASClient(timeout=0.5).open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "read", None)).type == "fail"
+        assert c.invoke(t, Op(0, "invoke", "write", 1)).type == "info"
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "consul-sim.tar.gz")
+        consul_sim.build_archive(archive, str(tmp_path / "s" / "c.json"))
+        t = consul.consul_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "consul": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 5,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        t["generator"] = gen.time_limit(
+            4, gen.clients(gen.stagger(
+                0.01, gen.mix([consul.r, consul.w, consul.cas]))))
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# RESP sim + disque + raftis
+
+
+@pytest.fixture
+def resp_port(tmp_path):
+    class H(redis_sim.Handler):
+        store = redis_sim.Store(str(tmp_path / "resp.json"))
+        mean_latency = 0.0
+
+    srv = redis_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestRespSim:
+    def test_ping_get_set(self, resp_port):
+        c = redis_proto.RespConn("127.0.0.1", resp_port)
+        assert c.call("PING") == "PONG"
+        assert c.call("GET", "r") is None
+        assert c.call("SET", "r", 5) == "OK"
+        assert c.call("GET", "r") == b"5"
+        c.close()
+
+    def test_unknown_command_errors(self, resp_port):
+        c = redis_proto.RespConn("127.0.0.1", resp_port)
+        with pytest.raises(redis_proto.RespError):
+            c.call("FLY")
+        # connection survives the error
+        assert c.call("PING") == "PONG"
+        c.close()
+
+    def test_job_lifecycle(self, resp_port):
+        c = redis_proto.RespConn("127.0.0.1", resp_port)
+        jid = c.call("ADDJOB", "q", "77", 100)
+        assert jid.startswith(b"D-")
+        got = c.call("GETJOB", "TIMEOUT", 10, "COUNT", 1, "FROM", "q")
+        assert got[0][1] == jid and got[0][2] == b"77"
+        assert c.call("ACKJOB", jid) == 1
+        # empty queue: nil after timeout
+        assert c.call("GETJOB", "TIMEOUT", 10, "COUNT", 1, "FROM", "q") is None
+        c.close()
+
+
+def _resp_cluster(tmp_path, nodes, binary):
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / f"{binary}.tar.gz")
+    redis_sim.build_archive(archive, str(tmp_path / "s" / "r.json"),
+                            binary=binary)
+    cfg = {
+        "addr_fn": lambda n: "127.0.0.1",
+        "ports": {n: free_port() for n in nodes},
+        "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+        "sudo": None,
+    }
+    return remote, archive, cfg
+
+
+class TestDisque:
+    def test_client_roundtrip(self, resp_port):
+        t = {"disque": {"addr_fn": lambda n: "127.0.0.1",
+                        "ports": {"n1": resp_port}}}
+        c = disque.DisqueClient().open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "enqueue", 1)).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "enqueue", 2)).type == "ok"
+        d = c.invoke(t, Op(0, "invoke", "dequeue", None))
+        assert d.type == "ok" and d.value in (1, 2)
+        drained = c.invoke(t, Op(0, "invoke", "drain", None))
+        assert drained.type == "ok" and len(drained.value) == 1
+        empty = c.invoke(t, Op(0, "invoke", "dequeue", None))
+        assert empty.type == "fail" and empty.error == "empty"
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = _resp_cluster(tmp_path, nodes,
+                                             "disque-server")
+        t = disque.disque_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "disque": cfg,
+            "concurrency": 4,
+            "time_limit": 4,
+            # quiesce must outlast the sim's in-flight RETRY_S so jobs
+            # taken by crashed consumers are redelivered before drain
+            "quiesce": 1.5,
+            "stagger": 0.01,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        # Bound the client phase by op COUNT, not the wall clock: an op
+        # in flight exactly at the time limit gets abandoned (:info)
+        # while its GETJOB+ACKJOB still lands server-side — a consumed
+        # job with no :ok record, which total-queue rightly calls lost.
+        # That at-least-once reporting gap is real disque behavior; the
+        # hermetic test avoids racing it.
+        t["generator"] = gen.phases(
+            gen.time_limit(8, gen.clients(
+                gen.limit(150, gen.stagger(0.01, disque.queue_gen())))),
+            gen.sleep(1.5),  # outlast the sim's RETRY_S redelivery
+            gen.clients(gen.each(
+                lambda: gen.once({"type": "invoke", "f": "drain"}))),
+        )
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        assert any(o.f == "drain" and o.type == "ok"
+                   for o in result["history"])
+
+
+class TestRaftis:
+    def test_client_roundtrip(self, resp_port):
+        t = {"raftis": {"addr_fn": lambda n: "127.0.0.1",
+                        "ports": {"n1": resp_port}}}
+        c = raftis.RaftisClient().open(t, "n1")
+        r0 = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r0.type == "ok" and r0.value is None
+        assert c.invoke(t, Op(0, "invoke", "write", 3)).type == "ok"
+        r1 = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r1.type == "ok" and r1.value == 3
+
+    def test_dead_node_taxonomy(self):
+        t = {"raftis": {"addr_fn": lambda n: "127.0.0.1",
+                        "ports": {"n1": free_port()}}}
+        with pytest.raises(Exception):
+            raftis.RaftisClient(timeout=0.3).open(t, "n1")
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = _resp_cluster(tmp_path, nodes, "raftis")
+        t = raftis.raftis_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "raftis": cfg,
+            "concurrency": 4,
+            "time_limit": 4,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        t["generator"] = gen.time_limit(
+            3, gen.clients(gen.stagger(0.01, gen.mix([raftis.r, raftis.w]))))
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
