@@ -251,14 +251,21 @@ def set_full(linearizable: bool = False) -> SetFull:
 
 def expand_queue_drain_ops(history) -> list:
     """Expand :drain ops (value = collection of elements) into dequeue
-    invoke/ok pairs (checker.clj:505-537)."""
+    invoke/ok pairs (checker.clj:505-537).
+
+    A crashed (:info) drain that carries a partial element list — e.g.
+    disque's drain hitting its deadline after acking some jobs — has
+    those elements expanded too (they were definitely consumed); the
+    drain's incompleteness is preserved simply by not having drained
+    the rest. Only a crashed drain with NO value is unhandleable, as in
+    the reference."""
     out = []
     for o in _ops(history):
         if o.f != "drain":
             out.append(o)
         elif o.is_invoke or o.is_fail:
             continue
-        elif o.is_ok:
+        elif o.is_ok or (o.is_info and isinstance(o.value, (list, tuple))):
             for element in o.value:
                 out.append(o.with_(type="invoke", f="dequeue", value=None))
                 out.append(o.with_(type="ok", f="dequeue", value=element))

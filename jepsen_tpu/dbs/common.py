@@ -1,0 +1,120 @@
+"""Shared plumbing for per-DB suites: the per-suite config accessors
+(addr_fn/ports/dir/sudo overrides under one test-map key) and the
+archive-install + daemon DB lifecycle that most suites share.
+
+Every suite keeps its own protocol client, workloads, and readiness
+probe — this factors only the mechanical parts so a lifecycle fix lands
+once instead of once per suite."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from .. import db
+from ..control import util as cu
+
+log = logging.getLogger("jepsen_tpu.dbs.common")
+
+
+class SuiteCfg:
+    """Accessors for a suite's config sub-map (test[name]): addressing,
+    ports, install dir, sudo — the knobs that differ between a real
+    cluster and a LocalRemote sandbox."""
+
+    def __init__(self, name: str, default_port: int, default_dir: str):
+        self.name = name
+        self.default_port = default_port
+        self.default_dir = default_dir
+
+    def cfg(self, test) -> dict:
+        return test.get(self.name) or {}
+
+    def host(self, test, node) -> str:
+        fn = self.cfg(test).get("addr_fn")
+        return fn(node) if fn else str(node)
+
+    def port(self, test, node) -> int:
+        ports = self.cfg(test).get("ports")
+        return ports[node] if ports else self.default_port
+
+    def dir(self, test, node) -> str:
+        d = self.cfg(test).get("dir", self.default_dir)
+        return d(node) if callable(d) else d
+
+    def sudo(self, test):
+        return self.cfg(test).get("sudo", True)
+
+
+class ArchiveDB(db.DB, db.LogFiles):
+    """The common suite DB shape: install an archive, start one daemon,
+    poll until ready, stop + wipe on teardown. Subclasses provide
+    `binary`, `daemon_args(test, node)`, and `probe_ready(test, node)
+    -> bool`; anything extra (cluster joins, bootstrap flags) hooks in
+    via `post_start(test, node)`."""
+
+    binary = "server"
+    log_name = "server.log"
+    pid_name = "server.pid"
+
+    def __init__(self, suite: SuiteCfg, archive_url: str | None = None,
+                 ready_timeout: float = 30.0):
+        self.suite = suite
+        self.archive_url = archive_url
+        self.ready_timeout = ready_timeout
+
+    def resolve_url(self, test) -> str:
+        url = self.archive_url or self.suite.cfg(test).get("archive_url")
+        if not url:
+            raise db.SetupFailed(
+                f"{self.suite.name} archive_url required (release "
+                "archive, or the in-repo sim archive for hermetic runs)")
+        return url
+
+    def daemon_args(self, test, node) -> list:
+        return []
+
+    def setup(self, test, node) -> None:
+        remote = test["remote"]
+        d = self.suite.dir(test, node)
+        cu.install_archive(remote, node, self.resolve_url(test), d,
+                           sudo=self.suite.sudo(test))
+        cu.start_daemon(
+            remote, node, f"{d}/{self.binary}",
+            *self.daemon_args(test, node),
+            logfile=f"{d}/{self.log_name}",
+            pidfile=f"{d}/{self.pid_name}",
+            chdir=d,
+        )
+        self.await_ready(test, node)
+        self.post_start(test, node)
+
+    def probe_ready(self, test, node) -> bool:
+        raise NotImplementedError
+
+    def await_ready(self, test, node) -> None:
+        deadline = time.monotonic() + self.ready_timeout
+        while True:
+            try:
+                if self.probe_ready(test, node):
+                    return
+            except OSError:
+                pass
+            if time.monotonic() > deadline:
+                raise db.SetupFailed(
+                    f"{self.suite.name} on {node} never became ready")
+            time.sleep(0.2)
+
+    def post_start(self, test, node) -> None:
+        pass
+
+    def teardown(self, test, node) -> None:
+        remote = test["remote"]
+        d = self.suite.dir(test, node)
+        log.info("%s tearing down %s", node, self.suite.name)
+        cu.stop_daemon(remote, node, f"{d}/{self.pid_name}")
+        remote.exec(node, ["rm", "-rf", d], sudo=self.suite.sudo(test),
+                    check=False)
+
+    def log_files(self, test, node) -> list:
+        return [f"{self.suite.dir(test, node)}/{self.log_name}"]

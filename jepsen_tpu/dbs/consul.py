@@ -29,9 +29,9 @@ import urllib.parse
 import urllib.request
 
 from .. import checker as checker_mod
-from .. import cli, client, db, generator as gen, models, nemesis, osdist
-from ..control import util as cu
+from .. import cli, client, generator as gen, models, nemesis, osdist
 from ..history import Op
+from .common import ArchiveDB, SuiteCfg
 
 log = logging.getLogger("jepsen_tpu.dbs.consul")
 
@@ -39,86 +39,37 @@ PORT = 8500
 KEY = "jepsen"
 
 
-def _cfg(test) -> dict:
-    return test.get("consul") or {}
+_suite = SuiteCfg("consul", PORT, "/opt/consul")
+node_host = _suite.host
+node_port = _suite.port
 
 
-def node_host(test, node) -> str:
-    fn = _cfg(test).get("addr_fn")
-    return fn(node) if fn else str(node)
-
-
-def node_port(test, node) -> int:
-    ports = _cfg(test).get("ports")
-    return ports[node] if ports else PORT
-
-
-def node_dir(test, node) -> str:
-    d = _cfg(test).get("dir", "/opt/consul")
-    return d(node) if callable(d) else d
-
-
-class ConsulDB(db.DB, db.LogFiles):
+class ConsulDB(ArchiveDB):
     """Consul agent per node (consul.clj:22-57): the first node runs
     -bootstrap, the rest -join it."""
 
+    binary = "consul"
+    log_name = "consul.log"
+    pid_name = "consul.pid"
+
     def __init__(self, archive_url: str | None = None,
                  ready_timeout: float = 30.0):
-        self.archive_url = archive_url
-        self.ready_timeout = ready_timeout
+        super().__init__(_suite, archive_url, ready_timeout)
 
-    def setup(self, test, node) -> None:
-        remote = test["remote"]
-        d = node_dir(test, node)
-        sudo = _cfg(test).get("sudo", True)
-        url = self.archive_url or _cfg(test).get("archive_url")
-        if not url:
-            raise db.SetupFailed(
-                "consul archive_url required (release zip/tarball, or "
-                "the consul_sim archive for hermetic runs)")
-        cu.install_archive(remote, node, url, d, sudo=sudo)
+    def daemon_args(self, test, node) -> list:
         primary = test["nodes"][0]
         extra = (["-bootstrap"] if node == primary
                  else ["-join", node_host(test, primary)])
-        cu.start_daemon(
-            remote, node, f"{d}/consul", "agent",
-            "-server",
-            "-node", str(node),
-            "-data-dir", f"{d}/data",
-            "-client", "0.0.0.0",
-            "-http-port", str(node_port(test, node)),
-            *extra,
-            logfile=f"{d}/consul.log",
-            pidfile=f"{d}/consul.pid",
-            chdir=d,
-        )
-        self.await_ready(test, node)
+        d = _suite.dir(test, node)
+        return ["agent", "-server", "-node", str(node),
+                "-data-dir", f"{d}/data", "-client", "0.0.0.0",
+                "-http-port", str(node_port(test, node)), *extra]
 
-    def await_ready(self, test, node) -> None:
-        deadline = time.monotonic() + self.ready_timeout
+    def probe_ready(self, test, node) -> bool:
         url = (f"http://{node_host(test, node)}:{node_port(test, node)}"
                "/v1/status/leader")
-        while True:
-            try:
-                with urllib.request.urlopen(url, timeout=2) as resp:
-                    if resp.status == 200 and resp.read().strip() != b'""':
-                        return
-            except OSError:
-                pass
-            if time.monotonic() > deadline:
-                raise db.SetupFailed(f"consul on {node} has no leader")
-            time.sleep(0.2)
-
-    def teardown(self, test, node) -> None:
-        remote = test["remote"]
-        d = node_dir(test, node)
-        log.info("%s tearing down consul", node)
-        cu.stop_daemon(remote, node, f"{d}/consul.pid")
-        remote.exec(node, ["rm", "-rf", d],
-                    sudo=_cfg(test).get("sudo", True), check=False)
-
-    def log_files(self, test, node) -> list:
-        return [f"{node_dir(test, node)}/consul.log"]
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return resp.status == 200 and resp.read().strip() != b'""' 
 
 
 class ConsulKV:

@@ -1,0 +1,106 @@
+"""A hermetic CrateDB lookalike: the HTTP _sql endpoint over the shared
+mini SQL engine (crdb_sim.execute), with Crate's implicit `_version`
+MVCC column managed by the engine. Every statement autocommits under
+the shared flock (Crate has no multi-statement transactions — its
+optimistic concurrency rides _version checks, which is exactly what the
+crate suite exercises)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import crdb_sim
+from .simbase import Store, build_sim_archive
+
+
+class Handler(BaseHTTPRequestHandler):
+    store: Store = None  # type: ignore[assignment]
+    mean_latency: float = 0.0
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        sys.stdout.write("%s - %s\n" % (self.address_string(), fmt % args))
+        sys.stdout.flush()
+
+    def _reply(self, status: int, body: dict):
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_POST(self):
+        if self.mean_latency > 0:
+            time.sleep(random.expovariate(1.0 / self.mean_latency))
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            stmt = json.loads(self.rfile.read(length) or b"{}")["stmt"]
+        except (json.JSONDecodeError, KeyError):
+            return self._reply(400, {"error": {"message": "bad request"}})
+
+        def run(data):
+            try:
+                cols, rows, tag = crdb_sim.execute(data, stmt)
+            except crdb_sim.SqlError as e:
+                return ("error", e), None
+            rowcount = 0
+            parts = tag.split()
+            if parts and parts[-1].isdigit():
+                rowcount = int(parts[-1])
+            return ("ok", (cols, rows, rowcount)), data
+
+        kind, payload = self.store.transact(run)
+        if kind == "error":
+            e = payload
+            code = 4091 if e.sqlstate == "23505" else 5000
+            return self._reply(409 if e.sqlstate == "23505" else 400, {
+                "error": {"message": f"duplicate key: {e.message}"
+                          if e.sqlstate == "23505" else e.message,
+                          "code": code}})
+        cols, rows, rowcount = payload
+        self._reply(200, {"cols": cols, "rows": [list(r) for r in rows],
+                          "rowcount": rowcount})
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description="crate _sql sim",
+                                allow_abbrev=False)
+    p.add_argument("--data", required=True)
+    p.add_argument("--mean-latency", type=float, default=0.0)
+    p.add_argument("--port", type=int, default=4200)
+    p.add_argument("--name", default="sim")
+    # real CrateDB's settings syntax: -Ckey=value (repeatable)
+    p.add_argument("-C", action="append", default=[], dest="settings")
+    return p.parse_args(argv)
+
+
+def serve(argv=None) -> None:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    settings = dict(s.split("=", 1) for s in args.settings if "=" in s)
+    port = int(settings.get("http.port", args.port))
+    name = settings.get("node.name", args.name)
+    Handler.store = Store(args.data)
+    Handler.mean_latency = args.mean_latency
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    print(f"crate-sim {name} serving on {port}, "
+          f"data={args.data}")
+    sys.stdout.flush()
+    httpd.serve_forever()
+
+
+def build_archive(dest: str, data_path: str, mean_latency: float = 0.0,
+                  python: str | None = None) -> str:
+    return build_sim_archive(
+        dest, "jepsen_tpu.dbs.crate_sim", "crate", "crate-sim",
+        data_path, mean_latency=mean_latency, python=python,
+    )
+
+
+if __name__ == "__main__":
+    serve()

@@ -170,6 +170,17 @@ def execute(data: dict, sql: str) -> tuple:
         data["tables"][name] = {"cols": cols, "rows": []}
         return [], [], "CREATE TABLE"
 
+    # crate-style implicit MVCC column: `alter table t add _version`
+    # gives every row a server-managed _version (1 on insert, bumped on
+    # every update) that WHERE clauses may check optimistically
+    m = re.fullmatch(r"alter\s+table\s+(\w+)\s+add\s+_version", s, re.I)
+    if m:
+        t = _table(data, m.group(1).lower())
+        if "_version" not in t["cols"]:
+            t["cols"].append("_version")
+            t["rows"] = [row + [1] for row in t["rows"]]
+        return [], [], "ALTER TABLE"
+
     # -- INSERT ----------------------------------------------------------
     m = re.fullmatch(r"insert\s+into\s+(\w+)\s*(?:\(([^)]*)\)\s*)?"
                      r"values\s*(.+)", s, re.I | re.S)
@@ -183,6 +194,8 @@ def execute(data: dict, sql: str) -> tuple:
             if len(vals) != len(cols):
                 raise SqlError("42601", "column/value count mismatch")
             by_col = dict(zip(cols, vals))
+            if "_version" in t["cols"] and "_version" not in by_col:
+                by_col["_version"] = 1  # server-managed MVCC column
             row = [by_col.get(c) for c in t["cols"]]
             # primary-key-ish duplicate check on an `id` column
             if "id" in by_col and any(
@@ -195,8 +208,11 @@ def execute(data: dict, sql: str) -> tuple:
         return [], [], f"INSERT 0 {count}"
 
     # -- SELECT ----------------------------------------------------------
+    # `for update` row locking is a no-op here: every transaction holds
+    # the global lock anyway
+    s_nolock = re.sub(r"\s+for\s+update\s*$", "", s, flags=re.I)
     m = re.fullmatch(r"select\s+(.+?)\s+from\s+(\w+)"
-                     r"(?:\s+where\s+(.+))?", s, re.I | re.S)
+                     r"(?:\s+where\s+(.+))?", s_nolock, re.I | re.S)
     if m:
         t = _table(data, m.group(2).lower())
         conds = _parse_where(m.group(3))
@@ -238,18 +254,42 @@ def execute(data: dict, sql: str) -> tuple:
                      r"(?:\s+where\s+(.+))?", s, re.I | re.S)
     if m:
         t = _table(data, m.group(1).lower())
-        sets = {}
-        for part in m.group(2).split(","):
-            sm = re.fullmatch(rf"\s*(\w+)\s*=\s*({_LIT})\s*", part, re.I)
+        # quote-aware assignment scan (commas may appear INSIDE string
+        # literals, so splitting the clause on "," would mangle them)
+        sets = []  # (col, fn(row-dict) -> value)
+        set_clause = m.group(2).strip()
+        assign_re = re.compile(
+            rf"(\w+)\s*=\s*({_LIT}|\w+\s*[+-]\s*\d+)\s*(?:,\s*|$)", re.I)
+        pos = 0
+        while pos < len(set_clause):
+            sm = assign_re.match(set_clause, pos)
             if not sm:
-                raise SqlError("42601", f"can't parse SET: {part!r}")
-            sets[sm.group(1).lower()] = _parse_lit(sm.group(2))
+                raise SqlError("42601",
+                               f"can't parse SET: {set_clause[pos:]!r}")
+            col, rhs = sm.group(1).lower(), sm.group(2).strip()
+            am = re.fullmatch(r"(\w+)\s*([+-])\s*(\d+)", rhs)
+            if am and am.group(1).lower() == col:
+                # arithmetic in place: col = col [+-] n (bank's
+                # in-place transfer shape)
+                delta = int(am.group(3))
+                if am.group(2) == "-":
+                    delta = -delta
+                sets.append((col,
+                             lambda rd, col=col, delta=delta:
+                             (rd.get(col) or 0) + delta))
+            else:
+                lit = _parse_lit(rhs)
+                sets.append((col, lambda rd, lit=lit: lit))
+            pos = sm.end()
         conds = _parse_where(m.group(3))
         count = 0
         for i, row in enumerate(t["rows"]):
             rd = dict(zip(t["cols"], row))
             if all(c.matches(rd) for c in conds):
-                rd.update(sets)
+                for col, fn in sets:
+                    rd[col] = fn(rd)
+                if "_version" in t["cols"]:
+                    rd["_version"] = (rd.get("_version") or 0) + 1
                 t["rows"][i] = [rd.get(c) for c in t["cols"]]
                 count += 1
         return [], [], f"UPDATE {count}"

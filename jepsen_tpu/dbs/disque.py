@@ -24,9 +24,9 @@ import time
 
 from .. import checker as checker_mod
 from .. import cli, client, db, generator as gen, nemesis, osdist, reconnect
-from ..control import util as cu
 from ..history import Op
 from . import redis_proto
+from .common import ArchiveDB, SuiteCfg
 
 log = logging.getLogger("jepsen_tpu.dbs.disque")
 
@@ -35,96 +35,55 @@ QUEUE = "jepsen"
 CLIENT_TIMEOUT_MS = 100  # job poll timeout
 
 
-def _cfg(test) -> dict:
-    return test.get("disque") or {}
+_suite = SuiteCfg("disque", PORT, "/opt/disque")
+node_host = _suite.host
+node_port = _suite.port
 
 
-def node_host(test, node) -> str:
-    fn = _cfg(test).get("addr_fn")
-    return fn(node) if fn else str(node)
+def _ping_ready(test, node) -> bool:
+    conn = redis_proto.RespConn(
+        node_host(test, node), node_port(test, node), timeout=2.0)
+    try:
+        return conn.call("PING") == "PONG"
+    finally:
+        conn.close()
 
 
-def node_port(test, node) -> int:
-    ports = _cfg(test).get("ports")
-    return ports[node] if ports else PORT
-
-
-def node_dir(test, node) -> str:
-    d = _cfg(test).get("dir", "/opt/disque")
-    return d(node) if callable(d) else d
-
-
-class DisqueDB(db.DB, db.LogFiles):
+class DisqueDB(ArchiveDB):
     """disque-server per node, joined via CLUSTER MEET to the primary
     (disque.clj:40-135). The reference builds from source on-node;
     archive mode installs a prebuilt (or sim) tarball through the same
     daemon machinery."""
 
+    binary = "disque-server"
+    log_name = "disque.log"
+    pid_name = "disque.pid"
+
     def __init__(self, archive_url: str | None = None,
                  ready_timeout: float = 30.0):
-        self.archive_url = archive_url
-        self.ready_timeout = ready_timeout
+        super().__init__(_suite, archive_url, ready_timeout)
 
-    def setup(self, test, node) -> None:
-        remote = test["remote"]
-        d = node_dir(test, node)
-        sudo = _cfg(test).get("sudo", True)
-        url = self.archive_url or _cfg(test).get("archive_url")
-        if not url:
-            raise db.SetupFailed(
-                "disque archive_url required (prebuilt tarball, or the "
-                "redis_sim archive for hermetic runs)")
-        cu.install_archive(remote, node, url, d, sudo=sudo)
-        cu.start_daemon(
-            remote, node, f"{d}/disque-server",
-            "--port", str(node_port(test, node)),
-            logfile=f"{d}/disque.log",
-            pidfile=f"{d}/disque.pid",
-            chdir=d,
-        )
-        self.await_ready(test, node)
+    def daemon_args(self, test, node) -> list:
+        return ["--port", str(node_port(test, node))]
+
+    def probe_ready(self, test, node) -> bool:
+        return _ping_ready(test, node)
+
+    def post_start(self, test, node) -> None:
         # join everyone to the primary (disque.clj:96-105)
         primary = test["nodes"][0]
-        if node != primary:
-            conn = redis_proto.RespConn(
-                node_host(test, node), node_port(test, node))
-            try:
-                res = conn.call("CLUSTER", "MEET",
-                                node_host(test, primary),
-                                node_port(test, primary))
-                if res != "OK":
-                    raise db.SetupFailed(f"cluster meet said {res!r}")
-            finally:
-                conn.close()
-
-    def await_ready(self, test, node) -> None:
-        deadline = time.monotonic() + self.ready_timeout
-        while True:
-            try:
-                conn = redis_proto.RespConn(
-                    node_host(test, node), node_port(test, node),
-                    timeout=2.0)
-                try:
-                    if conn.call("PING") == "PONG":
-                        return
-                finally:
-                    conn.close()
-            except OSError:
-                pass
-            if time.monotonic() > deadline:
-                raise db.SetupFailed(f"disque on {node} never ponged")
-            time.sleep(0.2)
-
-    def teardown(self, test, node) -> None:
-        remote = test["remote"]
-        d = node_dir(test, node)
-        log.info("%s tearing down disque", node)
-        cu.stop_daemon(remote, node, f"{d}/disque.pid")
-        remote.exec(node, ["rm", "-rf", d],
-                    sudo=_cfg(test).get("sudo", True), check=False)
-
-    def log_files(self, test, node) -> list:
-        return [f"{node_dir(test, node)}/disque.log"]
+        if node == primary:
+            return
+        conn = redis_proto.RespConn(
+            node_host(test, node), node_port(test, node))
+        try:
+            res = conn.call("CLUSTER", "MEET",
+                            node_host(test, primary),
+                            node_port(test, primary))
+            if res != "OK":
+                raise db.SetupFailed(f"cluster meet said {res!r}")
+        finally:
+            conn.close()
 
 
 class DisqueClient(client.Client):

@@ -1,0 +1,287 @@
+"""Elasticsearch test suite: version-CAS register and set workloads
+over the REST API (reference:
+/root/reference/elasticsearch/src/jepsen/elasticsearch/{core,sets}.clj
+— the reference drives the Java TransportClient; this speaks REST,
+which covers the same index/get/search/versioning semantics).
+
+Workloads:
+  - register: a document whose _version drives CAS (core.clj's
+    cas-set-client shape) — read = GET, write = unconditional index,
+    cas = GET then index with ?version
+  - set: op_type=create documents, final read = _refresh + _search
+    (sets.clj:50-87) — catches ES's near-real-time search losing
+    acknowledged writes
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import random
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from .. import checker as checker_mod
+from .. import cli, client, generator as gen, models, nemesis, osdist
+from ..history import Op
+from .common import ArchiveDB, SuiteCfg
+
+log = logging.getLogger("jepsen_tpu.dbs.elasticsearch")
+
+PORT = 9200
+INDEX = "jepsen"
+DOC_TYPE = "register"
+REG_ID = "0"
+
+
+_suite = SuiteCfg("elasticsearch", PORT, "/opt/elasticsearch")
+node_host = _suite.host
+node_port = _suite.port
+
+
+class EsDB(ArchiveDB):
+    """Tarball install + daemon (core.clj:212-296). Daemon args use
+    real Elasticsearch's -E settings syntax (the sim accepts them
+    too)."""
+
+    binary = "elasticsearch"
+    log_name = "es.log"
+    pid_name = "es.pid"
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 60.0):
+        super().__init__(_suite, archive_url, ready_timeout)
+
+    def daemon_args(self, test, node) -> list:
+        return ["-E", f"http.port={node_port(test, node)}",
+                "-E", f"node.name={node}"]
+
+    def probe_ready(self, test, node) -> bool:
+        url = (f"http://{node_host(test, node)}:{node_port(test, node)}"
+               "/_cluster/health")
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return resp.status == 200
+
+
+class EsConn:
+    """One node's REST endpoint."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def request(self, method: str, path: str, body=None, query=None):
+        url = self.base + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.load(resp)
+
+    def get_doc(self, doc_id: str):
+        """(source, version) or (None, 0)."""
+        try:
+            body = self.request("GET",
+                                f"/{INDEX}/{DOC_TYPE}/{doc_id}")
+            return body["_source"], int(body["_version"])
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None, 0
+            raise
+
+    def index_doc(self, doc_id: str, source: dict, version=None,
+                  create=False) -> bool:
+        """True on success, False on version conflict (409)."""
+        query = {}
+        if version is not None:
+            query["version"] = version
+        if create:
+            query["op_type"] = "create"
+        try:
+            self.request("PUT", f"/{INDEX}/{DOC_TYPE}/{doc_id}",
+                         body=source, query=query or None)
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return False
+            raise
+
+    def refresh(self) -> None:
+        self.request("POST", f"/{INDEX}/_refresh")
+
+    def search_all(self) -> list:
+        body = self.request("POST", f"/{INDEX}/_search",
+                            body={"query": {"match_all": {}},
+                                  "size": 10000})
+        return [h["_source"] for h in body["hits"]["hits"]]
+
+
+class RegisterClient(client.Client):
+    """Version-CAS register in one document. Reads :fail on error;
+    writes/cas crash to :info; a 409 conflict is a definite :fail."""
+
+    def __init__(self, conn: EsConn | None = None, timeout: float = 5.0):
+        self.conn = conn
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return RegisterClient(
+            EsConn(node_host(test, node), node_port(test, node),
+                   timeout=self.timeout), timeout=self.timeout)
+
+    def invoke(self, test, op: Op) -> Op:
+        crash = "fail" if op.f == "read" else "info"
+        try:
+            if op.f == "read":
+                source, _ = self.conn.get_doc(REG_ID)
+                value = source["value"] if source else None
+                return op.with_(type="ok", value=value)
+            if op.f == "write":
+                self.conn.index_doc(REG_ID, {"value": op.value})
+                return op.with_(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                source, version = self.conn.get_doc(REG_ID)
+                if source is None or source["value"] != old:
+                    return op.with_(type="fail")
+                ok = self.conn.index_doc(REG_ID, {"value": new},
+                                         version=version)
+                return op.with_(type="ok" if ok else "fail")
+            raise ValueError(f"unknown op {op.f!r}")
+        except (socket.timeout, TimeoutError):
+            return op.with_(type=crash, error="timeout")
+        except (urllib.error.URLError, OSError) as e:
+            return op.with_(type=crash, error=str(e))
+
+
+class SetClient(client.Client):
+    """op_type=create documents; final read refreshes then searches
+    (sets.clj:50-87). An indeterminate add is :info — the document may
+    have been indexed."""
+
+    def __init__(self, conn: EsConn | None = None, timeout: float = 5.0):
+        self.conn = conn
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return SetClient(
+            EsConn(node_host(test, node), node_port(test, node),
+                   timeout=self.timeout), timeout=self.timeout)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                ok = self.conn.index_doc(str(op.value),
+                                         {"num": op.value}, create=True)
+                return op.with_(type="ok" if ok else "fail")
+            if op.f == "read":
+                self.conn.refresh()
+                values = sorted(
+                    d["num"] for d in self.conn.search_all()
+                    if "num" in d)
+                return op.with_(type="ok", value=values)
+            raise ValueError(f"unknown op {op.f!r}")
+        except (socket.timeout, TimeoutError):
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error="timeout")
+        except (urllib.error.URLError, OSError) as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error=str(e))
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randrange(5)}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": (random.randrange(5), random.randrange(5))}
+
+
+def workloads() -> dict:
+    return {
+        "register": {
+            "client": RegisterClient(),
+            "during": gen.stagger(0.1, gen.mix([r, w, cas])),
+            "model": models.CASRegister(),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "linear": checker_mod.linearizable(),
+            }),
+        },
+        "set": {
+            "client": SetClient(),
+            "during": gen.stagger(
+                0.05,
+                gen.seq({"type": "invoke", "f": "add", "value": x}
+                        for x in itertools.count()),
+            ),
+            "final": gen.each(
+                lambda: gen.once({"type": "invoke", "f": "read"})),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "set": checker_mod.set_checker(),
+            }),
+        },
+    }
+
+
+def es_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    wl = workloads()[opts.get("workload", "register")]
+    generator = gen.time_limit(
+        opts.get("time_limit", 60),
+        gen.nemesis(gen.start_stop(10, 10), wl["during"]),
+    )
+    if wl.get("final") is not None:
+        generator = gen.phases(
+            generator,
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(opts.get("quiesce", 10)),
+            gen.clients(wl["final"]),
+        )
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": f"elasticsearch {opts.get('workload', 'register')}",
+            "os": osdist.debian,
+            "db": EsDB(archive_url=opts.get("archive_url")),
+            "client": wl["client"],
+            "nemesis": nemesis.partition_random_halves(),
+            "model": wl.get("model"),
+            "generator": generator,
+            "checker": wl["checker"],
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--workload", default="register",
+                   choices=sorted(workloads().keys()))
+    p.add_argument("--archive-url", dest="archive_url", default=None)
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(es_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,10 +23,11 @@ import socket
 import time
 
 from .. import checker as checker_mod
-from .. import cli, client, db, generator as gen, models, nemesis, osdist
-from ..control import util as cu
+from .. import cli, client, generator as gen, models, nemesis, osdist
+from .. import reconnect
 from ..history import Op
 from . import redis_proto
+from .common import ArchiveDB, SuiteCfg
 
 log = logging.getLogger("jepsen_tpu.dbs.raftis")
 
@@ -35,23 +36,9 @@ RAFT_PORT = 8901
 KEY = "r"
 
 
-def _cfg(test) -> dict:
-    return test.get("raftis") or {}
-
-
-def node_host(test, node) -> str:
-    fn = _cfg(test).get("addr_fn")
-    return fn(node) if fn else str(node)
-
-
-def node_port(test, node) -> int:
-    ports = _cfg(test).get("ports")
-    return ports[node] if ports else PORT
-
-
-def node_dir(test, node) -> str:
-    d = _cfg(test).get("dir", "/opt/raftis")
-    return d(node) if callable(d) else d
+_suite = SuiteCfg("raftis", PORT, "/opt/raftis")
+node_host = _suite.host
+node_port = _suite.port
 
 
 def initial_cluster(test) -> str:
@@ -61,86 +48,60 @@ def initial_cluster(test) -> str:
     )
 
 
-class RaftisDB(db.DB, db.LogFiles):
+class RaftisDB(ArchiveDB):
+    binary = "raftis"
+    log_name = "raftis.log"
+    pid_name = "raftis.pid"
+
     def __init__(self, archive_url: str | None = None,
                  ready_timeout: float = 30.0):
-        self.archive_url = archive_url
-        self.ready_timeout = ready_timeout
+        super().__init__(_suite, archive_url, ready_timeout)
 
-    def setup(self, test, node) -> None:
-        remote = test["remote"]
-        d = node_dir(test, node)
-        sudo = _cfg(test).get("sudo", True)
-        url = self.archive_url or _cfg(test).get("archive_url")
-        if not url:
-            raise db.SetupFailed(
-                "raftis archive_url required (binary tarball, or the "
-                "redis_sim archive for hermetic runs)")
-        cu.install_archive(remote, node, url, d, sudo=sudo)
-        cu.start_daemon(
-            remote, node, f"{d}/raftis",
-            "--port", str(node_port(test, node)),
-            "--cluster", initial_cluster(test),
-            logfile=f"{d}/raftis.log",
-            pidfile=f"{d}/raftis.pid",
-            chdir=d,
-        )
-        self.await_ready(test, node)
+    def daemon_args(self, test, node) -> list:
+        return ["--port", str(node_port(test, node)),
+                "--cluster", initial_cluster(test)]
 
-    def await_ready(self, test, node) -> None:
-        deadline = time.monotonic() + self.ready_timeout
-        while True:
-            try:
-                conn = redis_proto.RespConn(
-                    node_host(test, node), node_port(test, node),
-                    timeout=2.0)
-                try:
-                    if conn.call("PING") == "PONG":
-                        return
-                finally:
-                    conn.close()
-            except OSError:
-                pass
-            if time.monotonic() > deadline:
-                raise db.SetupFailed(f"raftis on {node} never ponged")
-            time.sleep(0.2)
-
-    def teardown(self, test, node) -> None:
-        remote = test["remote"]
-        d = node_dir(test, node)
-        log.info("%s tearing down raftis", node)
-        cu.stop_daemon(remote, node, f"{d}/raftis.pid")
-        remote.exec(node, ["rm", "-rf", d],
-                    sudo=_cfg(test).get("sudo", True), check=False)
-
-    def log_files(self, test, node) -> list:
-        return [f"{node_dir(test, node)}/raftis.log"]
+    def probe_ready(self, test, node) -> bool:
+        conn = redis_proto.RespConn(
+            node_host(test, node), node_port(test, node), timeout=2.0)
+        try:
+            return conn.call("PING") == "PONG"
+        finally:
+            conn.close()
 
 
 class RaftisClient(client.Client):
-    """GET/SET register with raftis.clj:44-57's taxonomy."""
+    """GET/SET register with raftis.clj:44-57's taxonomy. The RESP
+    connection lives behind a reconnect wrapper: after a timeout the
+    server's late reply would otherwise sit in the buffer and
+    desynchronize every later op's reply (off-by-one histories), so any
+    exception drops the connection and the next op gets a fresh one."""
 
-    def __init__(self, conn: redis_proto.RespConn | None = None,
-                 timeout: float = 5.0):
+    def __init__(self, conn=None, timeout: float = 5.0):
         self.conn = conn
         self.timeout = timeout
 
     def open(self, test, node):
-        conn = redis_proto.RespConn(
-            node_host(test, node), node_port(test, node),
-            timeout=self.timeout)
-        return RaftisClient(conn, timeout=self.timeout)
+        wrapped = reconnect.wrapper(
+            open=lambda: redis_proto.RespConn(
+                node_host(test, node), node_port(test, node),
+                timeout=self.timeout),
+            close=lambda c: c.close(),
+            name=f"raftis {node}",
+        ).open()
+        return RaftisClient(wrapped, timeout=self.timeout)
 
     def invoke(self, test, op: Op) -> Op:
         try:
-            if op.f == "read":
-                raw = self.conn.call("GET", KEY)
-                value = int(raw) if raw is not None else None
-                return op.with_(type="ok", value=value)
-            if op.f == "write":
-                self.conn.call("SET", KEY, op.value)
-                return op.with_(type="ok")
-            raise ValueError(f"unknown op {op.f!r}")
+            with self.conn.with_conn() as c:
+                if op.f == "read":
+                    raw = c.call("GET", KEY)
+                    value = int(raw) if raw is not None else None
+                    return op.with_(type="ok", value=value)
+                if op.f == "write":
+                    c.call("SET", KEY, op.value)
+                    return op.with_(type="ok")
+                raise ValueError(f"unknown op {op.f!r}")
         except redis_proto.RespError as e:
             # "no leader" means the write was rejected — definite fail
             # (raftis.clj:46-49)

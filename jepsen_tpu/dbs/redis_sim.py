@@ -180,6 +180,8 @@ class Handler(socketserver.StreamRequestHandler):
                 pending = list(qmap.get(q) or [])
                 while pending and len(out) < count:
                     jid = pending.pop(0)
+                    if jid not in jobs:
+                        continue  # acked while redelivery-queued: drop
                     job = dict(jobs[jid])
                     job["state"] = "active"
                     job["taken_at"] = now
@@ -208,13 +210,20 @@ class Handler(socketserver.StreamRequestHandler):
     def cmd_ackjob(self, args):
         def ack(data):
             jobs = dict(data.get("jobs") or {})
+            qmap = dict(data.get("queues") or {})
             n = 0
             for jid in args:
                 if jid in jobs:
+                    # drop from the job table AND any queue the
+                    # redelivery scan may have put it back on — a
+                    # dangling id would poison later GETJOBs
+                    q = jobs[jid]["queue"]
+                    if jid in (qmap.get(q) or []):
+                        qmap[q] = [j for j in qmap[q] if j != jid]
                     del jobs[jid]
                     n += 1
             new = dict(data)
-            new["jobs"] = jobs
+            new["jobs"], new["queues"] = jobs, qmap
             return n, new
 
         n = self.store.transact(ack)

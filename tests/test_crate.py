@@ -1,0 +1,159 @@
+"""Crate suite tests: _sql endpoint + _version MVCC semantics, the
+multiversion and lost-updates clients, and full engine runs (reference
+behavior: crate/src/jepsen/crate/*.clj)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, independent, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import crate, crate_sim
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+@pytest.fixture
+def sim(tmp_path):
+    class H(crate_sim.Handler):
+        store = crate_sim.Store(str(tmp_path / "crate.json"))
+        mean_latency = 0.0
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestSqlEndpointAndVersions:
+    def test_select_rowcount(self, sim):
+        c = crate.CrateConn("127.0.0.1", sim)
+        c.sql("create table t (id int primary key, v int)")
+        assert c.sql("insert into t values (1, 5)")["rowcount"] == 1
+        res = c.sql("select v from t where id = 1")
+        assert res["rows"] == [["5"]]
+
+    def test_version_bumps_on_update(self, sim):
+        c = crate.CrateConn("127.0.0.1", sim)
+        c.sql("create table r (id int primary key, v int)")
+        c.sql("alter table r add _version")
+        c.sql("insert into r (id, v) values (1, 0)")
+        assert c.sql("select _version from r where id = 1"
+                     )["rows"] == [["1"]]
+        c.sql("update r set v = 9 where id = 1")
+        assert c.sql("select _version from r where id = 1"
+                     )["rows"] == [["2"]]
+
+    def test_optimistic_version_check(self, sim):
+        c = crate.CrateConn("127.0.0.1", sim)
+        c.sql("create table s (id int primary key, v int)")
+        c.sql("alter table s add _version")
+        c.sql("insert into s (id, v) values (1, 0)")
+        # stale version: no rows updated
+        assert c.sql("update s set v = 5 where id = 1 and _version = 9"
+                     )["rowcount"] == 0
+        assert c.sql("update s set v = 5 where id = 1 and _version = 1"
+                     )["rowcount"] == 1
+
+    def test_duplicate_key_is_409(self, sim):
+        c = crate.CrateConn("127.0.0.1", sim)
+        c.sql("create table d (id int primary key, v int)")
+        c.sql("insert into d values (1, 1)")
+        with pytest.raises(crate.CrateError) as ei:
+            c.sql("insert into d values (1, 2)")
+        assert "duplicate" in str(ei.value).lower()
+
+
+class TestClients:
+    def _map(self, port):
+        return {"crate": {"addr_fn": lambda n: "127.0.0.1",
+                          "ports": {"n1": port}}}
+
+    def test_version_register(self, sim):
+        t = self._map(sim)
+        c = crate.VersionRegisterClient().open(t, "n1")
+        r0 = c.invoke(t, Op(0, "invoke", "read",
+                            independent.tuple_(1, None)))
+        assert r0.type == "ok" and r0.value == (1, (None, None))
+        assert c.invoke(t, Op(0, "invoke", "write",
+                              independent.tuple_(1, 7))).type == "ok"
+        r1 = c.invoke(t, Op(0, "invoke", "read",
+                            independent.tuple_(1, None)))
+        k, (value, version) = r1.value
+        assert value == 7 and version >= 1
+
+    def test_lost_updates_client(self, sim):
+        t = self._map(sim)
+        c = crate.LostUpdatesClient().open(t, "n1")
+        for v in (1, 2, 3):
+            assert c.invoke(t, Op(0, "invoke", "add",
+                                  independent.tuple_(0, v))).type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read",
+                           independent.tuple_(0, None)))
+        assert r.type == "ok" and r.value == (0, [1, 2, 3])
+
+    def test_multiversion_checker(self):
+        chk = crate.MultiversionChecker()
+        ok_hist = [
+            Op(0, "invoke", "read", None, index=0),
+            Op(0, "ok", "read", independent.tuple_(1, (5, 2)), index=1),
+            Op(1, "invoke", "read", None, index=2),
+            Op(1, "ok", "read", independent.tuple_(1, (5, 2)), index=3),
+        ]
+        assert chk.check({}, ok_hist, {})["valid"] is True
+        bad_hist = ok_hist[:3] + [
+            Op(1, "ok", "read", independent.tuple_(1, (9, 2)), index=3),
+        ]
+        res = chk.check({}, bad_hist, {})
+        assert res["valid"] is False and res["multis"]
+
+
+class TestFullRuns:
+    def _cluster(self, tmp_path, nodes):
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "crate-sim.tar.gz")
+        crate_sim.build_archive(archive, str(tmp_path / "s" / "c.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        }
+        return remote, archive, cfg
+
+    def _run(self, tmp_path, workload, **extra):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = self._cluster(tmp_path, nodes)
+        t = crate.crate_test({
+            "workload": workload,
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "crate": cfg,
+            "concurrency": 4,
+            "time_limit": 5,
+            "quiesce": 0.2,
+            **extra,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        return core.run(t)
+
+    def test_version_divergence(self, tmp_path):
+        result = self._run(tmp_path, "version-divergence")
+        assert result["results"]["valid"] is True, result["results"]
+
+    def test_lost_updates(self, tmp_path):
+        result = self._run(tmp_path, "lost-updates", keys=2,
+                           ops_per_key=15, time_limit=10)
+        res = result["results"]
+        assert res["valid"] is True, res
+        reads = [o for o in result["history"]
+                 if o.type == "ok" and o.f == "read"]
+        assert reads

@@ -1,0 +1,232 @@
+"""Suite tests for postgres-rds (bank over pgwire against a managed
+endpoint) and elasticsearch (version-CAS register + NRT set)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import crdb_sim, elasticsearch as es, es_sim
+from jepsen_tpu.dbs import postgres_rds as rds
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+# ---------------------------------------------------------------------------
+# postgres-rds
+
+
+@pytest.fixture
+def pg_port(tmp_path, monkeypatch):
+    monkeypatch.setattr(crdb_sim, "TXN_LOCK_TIMEOUT", 0.5)
+
+    class H(crdb_sim.Handler):
+        store = crdb_sim.Store(str(tmp_path / "pg.json"))
+        mean_latency = 0.0
+
+    srv = crdb_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def _rds_opts(pg_port, **extra):
+    return {
+        "nodes": ["rds-endpoint"],
+        "remote": None,
+        "postgres_rds": {"addr_fn": lambda n: "127.0.0.1",
+                         "ports": {"rds-endpoint": pg_port}},
+        "concurrency": 4,
+        **extra,
+    }
+
+
+class TestRdsBank:
+    def test_client_transfer_and_read(self, pg_port):
+        t = _rds_opts(pg_port)
+        c = rds.BankClient(n=4, starting_balance=10).open(t, "rds-endpoint")
+        c.setup(t)
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r.type == "ok" and r.value == [10, 10, 10, 10]
+        xfer = c.invoke(t, Op(0, "invoke", "transfer",
+                              {"from": 0, "to": 1, "amount": 3}))
+        assert xfer.type == "ok"
+        r2 = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r2.value == [7, 13, 10, 10] and sum(r2.value) == 40
+
+    def test_overdraft_fails_definitely(self, pg_port):
+        t = _rds_opts(pg_port)
+        c = rds.BankClient(n=2, starting_balance=10).open(t, "rds-endpoint")
+        c.setup(t)
+        res = c.invoke(t, Op(0, "invoke", "transfer",
+                             {"from": 0, "to": 1, "amount": 50}))
+        assert res.type == "fail" and res.error[0] == "negative"
+
+    def test_in_place_arithmetic(self, pg_port):
+        t = _rds_opts(pg_port)
+        c = rds.BankClient(n=2, starting_balance=10,
+                           in_place=True).open(t, "rds-endpoint")
+        c.setup(t)
+        assert c.invoke(t, Op(0, "invoke", "transfer",
+                              {"from": 0, "to": 1, "amount": 4})).type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r.value == [6, 14]
+
+    def test_checker_flags_wrong_total(self):
+        chk = rds.RdsBankChecker(2, 20)
+        good = [Op(0, "invoke", "read", None, index=0),
+                Op(0, "ok", "read", [10, 10], index=1)]
+        bad = [Op(0, "invoke", "read", None, index=0),
+               Op(0, "ok", "read", [10, 11], index=1)]
+        assert chk.check({}, good, {})["valid"] is True
+        res = chk.check({}, bad, {})
+        assert res["valid"] is False
+        assert res["bad_reads"][0]["type"] == "wrong-total"
+
+    def test_full_run(self, pg_port):
+        t = rds.rds_test(_rds_opts(
+            pg_port, time_limit=4, quiesce=0.2, stagger=0.01))
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+
+
+# ---------------------------------------------------------------------------
+# elasticsearch
+
+
+@pytest.fixture
+def es_port(tmp_path):
+    class H(es_sim.Handler):
+        store = es_sim.Store(str(tmp_path / "es.json"))
+        mean_latency = 0.0
+        refresh_lag = True
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def _es_test_map(port):
+    return {"elasticsearch": {"addr_fn": lambda n: "127.0.0.1",
+                              "ports": {"n1": port}}}
+
+
+class TestEsSim:
+    def test_version_cas(self, es_port):
+        conn = es.EsConn("127.0.0.1", es_port)
+        assert conn.get_doc("0") == (None, 0)
+        assert conn.index_doc("0", {"value": 1}) is True
+        source, version = conn.get_doc("0")
+        assert source == {"value": 1} and version == 1
+        assert conn.index_doc("0", {"value": 2}, version=1) is True
+        assert conn.index_doc("0", {"value": 9}, version=1) is False
+        assert conn.get_doc("0")[0] == {"value": 2}
+
+    def test_create_only_conflicts(self, es_port):
+        conn = es.EsConn("127.0.0.1", es_port)
+        assert conn.index_doc("7", {"num": 7}, create=True) is True
+        assert conn.index_doc("7", {"num": 7}, create=True) is False
+
+    def test_nrt_search_needs_refresh(self, es_port):
+        conn = es.EsConn("127.0.0.1", es_port)
+        conn.index_doc("5", {"num": 5}, create=True)
+        # search before refresh misses the write (near-real-time)
+        assert conn.search_all() == []
+        conn.refresh()
+        assert conn.search_all() == [{"num": 5}]
+
+
+class TestEsClients:
+    def test_register_taxonomy(self, es_port):
+        t = _es_test_map(es_port)
+        c = es.RegisterClient().open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "read", None)).value is None
+        assert c.invoke(t, Op(0, "invoke", "write", 3)).type == "ok"
+        good = c.invoke(t, Op(0, "invoke", "cas", (3, 4)))
+        assert good.type == "ok"
+        bad = c.invoke(t, Op(0, "invoke", "cas", (3, 9)))
+        assert bad.type == "fail"
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r.value == 4
+
+    def test_set_client_roundtrip(self, es_port):
+        t = _es_test_map(es_port)
+        c = es.SetClient().open(t, "n1")
+        for v in (1, 2, 3):
+            assert c.invoke(t, Op(0, "invoke", "add", v)).type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r.type == "ok" and r.value == [1, 2, 3]
+
+    def test_dead_node(self):
+        t = _es_test_map(free_port())
+        c = es.RegisterClient(timeout=0.5).open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "read", None)).type == "fail"
+        assert c.invoke(t, Op(0, "invoke", "write", 1)).type == "info"
+
+
+class TestEsFullRuns:
+    def _cluster(self, tmp_path, nodes):
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "es-sim.tar.gz")
+        es_sim.build_archive(archive, str(tmp_path / "s" / "es.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        }
+        return remote, archive, cfg
+
+    def test_register_workload(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = self._cluster(tmp_path, nodes)
+        t = es.es_test({
+            "workload": "register",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "elasticsearch": cfg,
+            "concurrency": 4,
+            "time_limit": 4,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        t["generator"] = gen.time_limit(3, gen.clients(
+            gen.stagger(0.02, gen.mix([es.r, es.w, es.cas]))))
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+
+    def test_set_workload(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = self._cluster(tmp_path, nodes)
+        t = es.es_test({
+            "workload": "set",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "elasticsearch": cfg,
+            "concurrency": 4,
+            "time_limit": 4,
+            "quiesce": 0.2,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        wl = es.workloads()["set"]
+        t["client"] = wl["client"]
+        t["generator"] = gen.phases(
+            gen.time_limit(3, gen.clients(gen.stagger(0.01, wl["during"]))),
+            gen.clients(wl["final"]),
+        )
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
