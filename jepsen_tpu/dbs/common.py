@@ -118,3 +118,32 @@ class ArchiveDB(db.DB, db.LogFiles):
 
     def log_files(self, test, node) -> list:
         return [f"{self.suite.dir(test, node)}/{self.log_name}"]
+
+
+def shared_flag() -> dict:
+    """A once-guard shared across a client's clones (the reference's
+    (locking tbl-created? (compare-and-set! ...)) idiom)."""
+    import threading
+
+    return {"lock": threading.Lock(), "created": False}
+
+
+def once(flag: dict, fn) -> None:
+    """Run fn exactly once across all holders of the flag."""
+    with flag["lock"]:
+        if not flag["created"]:
+            fn()
+            flag["created"] = True
+
+
+def resp_ping_ready(suite: SuiteCfg, test, node,
+                    timeout: float = 2.0) -> bool:
+    """Readiness probe for RESP-protocol suites (disque, raftis)."""
+    from . import redis_proto
+
+    conn = redis_proto.RespConn(
+        suite.host(test, node), suite.port(test, node), timeout=timeout)
+    try:
+        return conn.call("PING") == "PONG"
+    finally:
+        conn.close()

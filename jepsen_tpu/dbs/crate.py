@@ -32,7 +32,8 @@ from .. import cli, client, generator as gen, independent, nemesis
 from .. import osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, SuiteCfg, once as _once, \
+    shared_flag as _shared_flag
 
 log = logging.getLogger("jepsen_tpu.dbs.crate")
 
@@ -111,19 +112,6 @@ def _ensure_version_column(conn, table: str) -> None:
         conn.sql(f"alter table {table} add _version")
     except CrateError:
         pass
-
-
-def _shared_flag():
-    import threading
-
-    return {"lock": threading.Lock(), "created": False}
-
-
-def _once(flag, fn) -> None:
-    with flag["lock"]:
-        if not flag["created"]:
-            fn()
-            flag["created"] = True
 
 
 class VersionRegisterClient(client.Client):

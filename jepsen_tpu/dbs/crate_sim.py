@@ -53,7 +53,10 @@ class Handler(BaseHTTPRequestHandler):
             parts = tag.split()
             if parts and parts[-1].isdigit():
                 rowcount = int(parts[-1])
-            return ("ok", (cols, rows, rowcount)), data
+            # pure reads don't rewrite the state file (a full json dump
+            # under the global lock per SELECT would dominate latency)
+            new = None if tag.startswith("SELECT") else data
+            return ("ok", (cols, rows, rowcount)), new
 
         kind, payload = self.store.transact(run)
         if kind == "error":

@@ -26,7 +26,7 @@ from .. import checker as checker_mod
 from .. import cli, client, db, generator as gen, nemesis, osdist, reconnect
 from ..history import Op
 from . import redis_proto
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, SuiteCfg, resp_ping_ready
 
 log = logging.getLogger("jepsen_tpu.dbs.disque")
 
@@ -38,15 +38,6 @@ CLIENT_TIMEOUT_MS = 100  # job poll timeout
 _suite = SuiteCfg("disque", PORT, "/opt/disque")
 node_host = _suite.host
 node_port = _suite.port
-
-
-def _ping_ready(test, node) -> bool:
-    conn = redis_proto.RespConn(
-        node_host(test, node), node_port(test, node), timeout=2.0)
-    try:
-        return conn.call("PING") == "PONG"
-    finally:
-        conn.close()
 
 
 class DisqueDB(ArchiveDB):
@@ -67,7 +58,7 @@ class DisqueDB(ArchiveDB):
         return ["--port", str(node_port(test, node))]
 
     def probe_ready(self, test, node) -> bool:
-        return _ping_ready(test, node)
+        return resp_ping_ready(_suite, test, node)
 
     def post_start(self, test, node) -> None:
         # join everyone to the primary (disque.clj:96-105)
@@ -115,7 +106,28 @@ class DisqueClient(client.Client):
         c.call("ACKJOB", jid)
         return jid, body
 
+    def _drain(self, op: Op) -> Op:
+        """Dequeue until empty. Errors mid-drain keep the values already
+        ACKed — dropping them would make the queue checker count
+        definitely-consumed jobs as lost."""
+        values = []
+        deadline = time.monotonic() + 10.0
+        try:
+            with self.conn.with_conn() as c:
+                while time.monotonic() < deadline:
+                    got = self._dequeue_once(c)
+                    if got is None:
+                        return op.with_(type="ok", value=values)
+                    values.append(int(got[1].decode()))
+            return op.with_(type="info", error="drain-timeout",
+                            value=values)
+        except (redis_proto.RespError, ConnectionError, socket.timeout,
+                TimeoutError, OSError) as e:
+            return op.with_(type="info", error=str(e), value=values)
+
     def invoke(self, test, op: Op) -> Op:
+        if op.f == "drain":
+            return self._drain(op)
         try:
             with self.conn.with_conn() as c:
                 if op.f == "enqueue":
@@ -126,16 +138,6 @@ class DisqueClient(client.Client):
                     if got is None:
                         return op.with_(type="fail", error="empty")
                     return op.with_(type="ok", value=int(got[1].decode()))
-                if op.f == "drain":
-                    values = []
-                    deadline = time.monotonic() + 10.0
-                    while time.monotonic() < deadline:
-                        got = self._dequeue_once(c)
-                        if got is None:
-                            return op.with_(type="ok", value=values)
-                        values.append(int(got[1].decode()))
-                    return op.with_(type="info", error="drain-timeout",
-                                    value=values)
                 raise ValueError(f"unknown op {op.f!r}")
         except redis_proto.RespError as e:
             return op.with_(type="info", error=str(e))

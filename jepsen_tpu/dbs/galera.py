@@ -1,0 +1,37 @@
+"""MariaDB Galera Cluster test suite: bank, sets, and dirty-reads
+workloads over the MySQL protocol (reference:
+/root/reference/galera/src/jepsen/galera.clj:1-383 and
+galera/dirty_reads.clj:1-120; clients live in mysql_common.py).
+
+The real path installs a mariadb+galera archive whose mysqld is started
+with a wsrep gcomm:// cluster address (galera.clj:34-73); the hermetic
+path runs dbs/mysql_sim through the same daemon machinery."""
+
+from __future__ import annotations
+
+from .. import cli
+from .mysql_common import make_sql_suite
+
+
+def _daemon_args(suite, test, node) -> list:
+    gcomm = ",".join(suite.host(test, n) for n in test["nodes"]
+                     if n != node)
+    return ["--port", str(suite.port(test, node)),
+            f"--wsrep-cluster-address=gcomm://{gcomm}"]
+
+
+suite, GaleraDB, workloads, galera_test, _opt_spec = make_sql_suite(
+    "galera", 3306, "mysqld", _daemon_args,
+    ("bank", "sets", "dirty-reads"))
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(galera_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

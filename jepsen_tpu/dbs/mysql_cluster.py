@@ -1,0 +1,33 @@
+"""MySQL Cluster (NDB) test suite: bank and sets workloads over the
+MySQL protocol (reference:
+/root/reference/mysql-cluster/src/jepsen/mysql_cluster.clj:1-227;
+clients live in mysql_common.py). mysqld nodes point at the management
+node (the first node) via --ndb-connectstring."""
+
+from __future__ import annotations
+
+from .. import cli
+from .mysql_common import make_sql_suite
+
+
+def _daemon_args(suite, test, node) -> list:
+    mgmt = suite.host(test, test["nodes"][0])
+    return ["--port", str(suite.port(test, node)),
+            f"--ndb-connectstring={mgmt}"]
+
+
+suite, MysqlClusterDB, workloads, mysql_cluster_test, _opt_spec = \
+    make_sql_suite("mysql-cluster", 3306, "mysqld", _daemon_args,
+                   ("bank", "sets"))
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(mysql_cluster_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

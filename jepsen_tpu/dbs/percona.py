@@ -1,0 +1,34 @@
+"""Percona XtraDB Cluster test suite: bank, sets, and dirty-reads
+workloads over the MySQL protocol (reference:
+/root/reference/percona/src/jepsen/percona.clj:1-362 and
+percona/dirty_reads.clj; clients live in mysql_common.py — Percona's
+suite is the Galera pattern on Percona's distribution)."""
+
+from __future__ import annotations
+
+from .. import cli
+from .mysql_common import make_sql_suite
+
+
+def _daemon_args(suite, test, node) -> list:
+    gcomm = ",".join(suite.host(test, n) for n in test["nodes"]
+                     if n != node)
+    return ["--port", str(suite.port(test, node)),
+            f"--wsrep-cluster-address=gcomm://{gcomm}"]
+
+
+suite, PerconaDB, workloads, percona_test, _opt_spec = make_sql_suite(
+    "percona", 3306, "mysqld", _daemon_args,
+    ("bank", "sets", "dirty-reads"))
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(percona_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ import random
 import socket
 
 from .. import checker as checker_mod
+from .common import once as _once, shared_flag as _shared_flag
 from .. import cli, client, db, generator as gen, nemesis, reconnect
 from ..checker import Checker
 from ..history import Op, ops as _ops
@@ -72,14 +73,12 @@ class BankClient(client.Client):
     def __init__(self, n: int = 8, starting_balance: int = 10,
                  lock_type: str = "", in_place: bool = False,
                  conn=None, flag=None):
-        import threading
-
         self.n = n
         self.starting_balance = starting_balance
         self.lock_type = lock_type
         self.in_place = in_place
         self.conn = conn
-        self.flag = flag or {"lock": threading.Lock(), "created": False}
+        self.flag = flag or _shared_flag()
 
     def open(self, test, node):
         host, port = endpoint(test)
@@ -93,9 +92,7 @@ class BankClient(client.Client):
                           self.in_place, wrapped, self.flag)
 
     def setup(self, test):
-        with self.flag["lock"]:
-            if self.flag["created"]:
-                return
+        def create():
             with self.conn.with_conn() as c:
                 c.query("drop table if exists accounts")
                 c.query("create table accounts "
@@ -108,7 +105,8 @@ class BankClient(client.Client):
                     except pg_proto.PgError as e:
                         if "duplicate key" not in str(e):
                             raise
-            self.flag["created"] = True
+
+        _once(self.flag, create)
 
     def invoke(self, test, op: Op) -> Op:
         try:

@@ -27,7 +27,7 @@ from .. import cli, client, generator as gen, models, nemesis, osdist
 from .. import reconnect
 from ..history import Op
 from . import redis_proto
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, SuiteCfg, resp_ping_ready
 
 log = logging.getLogger("jepsen_tpu.dbs.raftis")
 
@@ -62,12 +62,7 @@ class RaftisDB(ArchiveDB):
                 "--cluster", initial_cluster(test)]
 
     def probe_ready(self, test, node) -> bool:
-        conn = redis_proto.RespConn(
-            node_host(test, node), node_port(test, node), timeout=2.0)
-        try:
-            return conn.call("PING") == "PONG"
-        finally:
-            conn.close()
+        return resp_ping_ready(_suite, test, node)
 
 
 class RaftisClient(client.Client):

@@ -1,0 +1,37 @@
+"""TiDB test suite: register, bank, and sets workloads over the MySQL
+protocol (reference: /root/reference/tidb/src/tidb/{core,db,register,
+bank,sets,sql}.clj; clients live in mysql_common.py).
+
+TiDB listens on 4000; the real deployment is a pd/tikv/tidb triple per
+node (tidb/db.clj:1-223) — the archive's `tidb-server` binary is
+expected to wrap that bring-up; the hermetic path runs dbs/mysql_sim
+through the same daemon machinery."""
+
+from __future__ import annotations
+
+from .. import cli
+from .mysql_common import make_sql_suite
+
+
+def _daemon_args(suite, test, node) -> list:
+    pd = ",".join(f"{suite.host(test, n)}:2379" for n in test["nodes"])
+    return ["--port", str(suite.port(test, node)),
+            "--store", "tikv",
+            "--path", pd]
+
+
+suite, TidbDB, workloads, tidb_test, _opt_spec = make_sql_suite(
+    "tidb", 4000, "tidb-server", _daemon_args,
+    ("register", "bank", "sets"))
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(tidb_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()
