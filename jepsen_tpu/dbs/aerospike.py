@@ -1,0 +1,250 @@
+"""Aerospike test suite: generation-CAS register and counter workloads
+(reference: /root/reference/aerospike/src/aerospike/{core,support,
+cas_register,counter}.clj — the reference rides the Java client; this
+speaks the wire subset in aerospike_proto).
+
+Workloads:
+  - cas-register: read returns (generation, value); cas re-writes with
+    GENERATION_EQUAL — result code 3 is a definite :fail (someone else
+    won the race); writes are unconditional.
+  - counter: unconditional add-like writes of a running total plus
+    reads; the counter checker bounds the final value by acknowledged
+    increments.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+import socket
+
+from .. import checker as checker_mod
+from .. import cli, client, generator as gen, models, nemesis, osdist
+from ..history import Op
+from . import aerospike_proto as ap
+from .common import ArchiveDB, SuiteCfg
+
+log = logging.getLogger("jepsen_tpu.dbs.aerospike")
+
+PORT = 3000
+KEY = "jepsen"
+
+
+_suite = SuiteCfg("aerospike", PORT, "/opt/aerospike")
+node_host = _suite.host
+node_port = _suite.port
+
+
+class AerospikeDB(ArchiveDB):
+    """asd per node (support.clj's install/configure/start)."""
+
+    binary = "asd"
+    log_name = "aerospike.log"
+    pid_name = "aerospike.pid"
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 60.0):
+        super().__init__(_suite, archive_url, ready_timeout)
+
+    def daemon_args(self, test, node) -> list:
+        return ["--port", str(node_port(test, node))]
+
+    def probe_ready(self, test, node) -> bool:
+        conn = ap.AerospikeConn(node_host(test, node),
+                                node_port(test, node),
+                                timeout=2.0, connect_timeout=2.0)
+        try:
+            conn.get("__probe__")
+            return True
+        except ap.AerospikeError:
+            return True  # server answered: protocol is up
+        finally:
+            conn.close()
+
+
+class CasRegisterClient(client.Client):
+    """Register via generation CAS (aerospike's cas-register
+    workload): read = get(gen, value); cas = read then put with
+    GENERATION_EQUAL; generation mismatch (code 3) is a definite
+    :fail."""
+
+    def __init__(self, conn=None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return CasRegisterClient(
+            ap.AerospikeConn(node_host(test, node),
+                             node_port(test, node)))
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                _gen, bins = self.conn.get(KEY)
+                return op.with_(
+                    type="ok",
+                    value=bins.get("value") if bins else None)
+            if op.f == "write":
+                self.conn.put(KEY, {"value": op.value})
+                return op.with_(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                generation, bins = self.conn.get(KEY)
+                if bins is None or bins.get("value") != old:
+                    return op.with_(type="fail")
+                try:
+                    self.conn.put(KEY, {"value": new},
+                                  expected_generation=generation)
+                    return op.with_(type="ok")
+                except ap.AerospikeError as e:
+                    if e.code == ap.RESULT_GENERATION:
+                        return op.with_(type="fail",
+                                        error="generation-mismatch")
+                    raise
+            raise ValueError(f"unknown op {op.f!r}")
+        except ap.AerospikeError as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error=f"code-{e.code}")
+        except (socket.timeout, TimeoutError):
+            return op.with_(
+                type="fail" if op.f == "read" else "info",
+                error="timeout")
+        except (ConnectionError, OSError) as e:
+            return op.with_(
+                type="fail" if op.f == "read" else "info", error=str(e))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+class CounterClient(client.Client):
+    """Counter via read-increment-write with generation CAS retried
+    until it lands (aerospike's counter workload shape); emits :add ops
+    with the delta and :read ops with the observed total, for the
+    framework counter checker."""
+
+    RETRIES = 16
+
+    def __init__(self, conn=None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return CounterClient(
+            ap.AerospikeConn(node_host(test, node),
+                             node_port(test, node)))
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                _gen, bins = self.conn.get(KEY)
+                return op.with_(
+                    type="ok",
+                    value=bins.get("count", 0) if bins else 0)
+            if op.f == "add":
+                for _ in range(self.RETRIES):
+                    generation, bins = self.conn.get(KEY)
+                    current = bins.get("count", 0) if bins else 0
+                    try:
+                        self.conn.put(
+                            KEY, {"count": current + op.value},
+                            expected_generation=generation or 0)
+                        return op.with_(type="ok")
+                    except ap.AerospikeError as e:
+                        if e.code != ap.RESULT_GENERATION:
+                            raise
+                return op.with_(type="fail", error="retries-exhausted")
+            raise ValueError(f"unknown op {op.f!r}")
+        except ap.AerospikeError as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error=f"code-{e.code}")
+        except (socket.timeout, TimeoutError, ConnectionError, OSError) as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error=str(e))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randrange(5)}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": (random.randrange(5), random.randrange(5))}
+
+
+def add(test, process):
+    return {"type": "invoke", "f": "add", "value": 1}
+
+
+def workloads(opts: dict) -> dict:
+    return {
+        "cas-register": {
+            "client": CasRegisterClient(),
+            "during": gen.stagger(opts.get("stagger", 0.05),
+                                  gen.mix([r, w, cas, cas])),
+            "model": models.CASRegister(),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "linear": checker_mod.linearizable(),
+            }),
+        },
+        "counter": {
+            "client": CounterClient(),
+            "during": gen.stagger(opts.get("stagger", 0.05),
+                                  gen.mix([add, add, r])),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "counter": checker_mod.counter(),
+            }),
+        },
+    }
+
+
+def aerospike_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    wl = workloads(opts)[opts.get("workload", "cas-register")]
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": f"aerospike {opts.get('workload', 'cas-register')}",
+            "os": osdist.debian,
+            "db": AerospikeDB(archive_url=opts.get("archive_url")),
+            "client": wl["client"],
+            "nemesis": nemesis.partition_random_halves(),
+            "model": wl.get("model"),
+            "generator": gen.time_limit(
+                opts.get("time_limit", 60),
+                gen.nemesis(gen.start_stop(10, 10), wl["during"]),
+            ),
+            "checker": wl["checker"],
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--workload", default="cas-register",
+                   choices=["cas-register", "counter"])
+    p.add_argument("--archive-url", dest="archive_url", default=None)
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(aerospike_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

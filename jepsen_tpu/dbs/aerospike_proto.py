@@ -1,0 +1,186 @@
+"""Minimal Aerospike wire protocol — the transport for the aerospike
+suite's cas-register and counter workloads (the reference drives the
+Java client, aerospike/src/aerospike/support.clj; the semantics that
+matter are generation-checked writes: read returns (generation, bins),
+write can demand GENERATION_EQUAL and fails with result code 3 on a
+lost race).
+
+Message layout (v2 type-3 'message' protos):
+  proto header: version(1)=2, type(1)=3, length(6, big-endian)
+  msg header:   header_sz(1)=22, info1, info2, info3, unused,
+                result_code, generation(u32), record_ttl(u32),
+                transaction_ttl(u32), n_fields(u16), n_ops(u16)
+  fields:       size(u32 incl. type byte), type(1), data
+                (0=namespace, 1=set, 4=ripemd160 key digest)
+  ops:          size(u32), op(1) (1=read, 2=write), bin_type(1),
+                version(1), name_len(1), name, value
+
+Integers travel as 8-byte big-endian bin type 1; blobs/strings as type
+3/4 raw bytes. Key digest = RIPEMD160(set + type_byte + key-bytes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+
+INFO1_READ = 0x01
+INFO1_GET_ALL = 0x02
+INFO2_WRITE = 0x01
+INFO2_GENERATION = 0x04   # write iff generation matches
+
+FIELD_NAMESPACE = 0
+FIELD_SET = 1
+FIELD_DIGEST = 4
+
+OP_READ = 1
+OP_WRITE = 2
+
+BIN_TYPE_INTEGER = 1
+BIN_TYPE_STRING = 3
+
+RESULT_OK = 0
+RESULT_NOT_FOUND = 2
+RESULT_GENERATION = 3
+
+
+class AerospikeError(Exception):
+    def __init__(self, code: int, message: str = ""):
+        super().__init__(message or f"result code {code}")
+        self.code = code
+
+
+def key_digest(set_name: str, key) -> bytes:
+    """RIPEMD160 over set + key-type byte + key bytes (the client
+    contract every aerospike driver implements)."""
+    if isinstance(key, int):
+        kb = b"\x01" + struct.pack(">q", key)
+    else:
+        kb = b"\x03" + str(key).encode()
+    return hashlib.new("ripemd160", set_name.encode() + kb).digest()
+
+
+def _field(ftype: int, data: bytes) -> bytes:
+    return struct.pack(">IB", len(data) + 1, ftype) + data
+
+
+def _encode_bin_value(v) -> tuple:
+    if isinstance(v, int):
+        return BIN_TYPE_INTEGER, struct.pack(">q", v)
+    return BIN_TYPE_STRING, str(v).encode()
+
+
+def _op(op_type: int, name: str, value=None) -> bytes:
+    nb = name.encode()
+    if value is None:
+        body = struct.pack(">BBBB", op_type, 0, 0, len(nb)) + nb
+    else:
+        btype, vb = _encode_bin_value(value)
+        body = struct.pack(">BBBB", op_type, btype, 0, len(nb)) + nb + vb
+    return struct.pack(">I", len(body)) + body
+
+
+def decode_bin(btype: int, data: bytes):
+    if btype == BIN_TYPE_INTEGER:
+        return struct.unpack(">q", data)[0]
+    return data.decode(errors="replace")
+
+
+def build_message(info1: int, info2: int, generation: int,
+                  fields: list, ops: list) -> bytes:
+    body = struct.pack(
+        ">BBBBBBIIIHH", 22, info1, info2, 0, 0, 0, generation, 0, 1000,
+        len(fields), len(ops))
+    body += b"".join(fields) + b"".join(ops)
+    return struct.pack(">BB", 2, 3) + len(body).to_bytes(6, "big") + body
+
+
+def parse_message(payload: bytes) -> tuple:
+    """(result_code, generation, bins, n_fields_skipped)."""
+    (hdr_sz, _i1, _i2, _i3, _unused, result, generation, _ttl, _txn,
+     n_fields, n_ops) = struct.unpack(">BBBBBBIIIHH", payload[:22])
+    pos = hdr_sz
+    for _ in range(n_fields):
+        (size,) = struct.unpack_from(">I", payload, pos)
+        pos += 4 + size
+    bins = {}
+    for _ in range(n_ops):
+        (size,) = struct.unpack_from(">I", payload, pos)
+        op_type, btype, _ver, name_len = struct.unpack_from(
+            ">BBBB", payload, pos + 4)
+        name = payload[pos + 8:pos + 8 + name_len].decode()
+        value = payload[pos + 8 + name_len:pos + 4 + size]
+        bins[name] = decode_bin(btype, value) if value else None
+        pos += 4 + size
+    return result, generation, bins
+
+
+class AerospikeConn:
+    def __init__(self, host: str, port: int, namespace: str = "jepsen",
+                 set_name: str = "jepsen", timeout: float = 5.0,
+                 connect_timeout: float = 10.0):
+        self.namespace = namespace
+        self.set_name = set_name
+        self.sock = socket.create_connection((host, port),
+                                             timeout=connect_timeout)
+        self.sock.settimeout(timeout)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("aerospike connection closed")
+            buf += chunk
+        return buf
+
+    def _roundtrip(self, msg: bytes) -> tuple:
+        self.sock.sendall(msg)
+        header = self._read_exact(8)
+        version, mtype = header[0], header[1]
+        length = int.from_bytes(header[2:8], "big")
+        payload = self._read_exact(length)
+        if version != 2 or mtype != 3:
+            raise AerospikeError(-1, f"bad proto {version}/{mtype}")
+        return parse_message(payload)
+
+    def _key_fields(self, key) -> list:
+        return [
+            _field(FIELD_NAMESPACE, self.namespace.encode()),
+            _field(FIELD_SET, self.set_name.encode()),
+            _field(FIELD_DIGEST, key_digest(self.set_name, key)),
+        ]
+
+    def get(self, key) -> tuple:
+        """(generation, bins) or (None, None) when absent."""
+        msg = build_message(INFO1_READ | INFO1_GET_ALL, 0, 0,
+                            self._key_fields(key), [])
+        result, generation, bins = self._roundtrip(msg)
+        if result == RESULT_NOT_FOUND:
+            return None, None
+        if result != RESULT_OK:
+            raise AerospikeError(result)
+        return generation, bins
+
+    def put(self, key, bins: dict, expected_generation: int | None = None
+            ) -> None:
+        """Write bins; with expected_generation, demand
+        GENERATION_EQUAL (raises AerospikeError code 3 on mismatch)."""
+        info2 = INFO2_WRITE
+        generation = 0
+        if expected_generation is not None:
+            info2 |= INFO2_GENERATION
+            generation = expected_generation
+        ops = [_op(OP_WRITE, name, v) for name, v in bins.items()]
+        msg = build_message(0, info2, generation,
+                            self._key_fields(key), ops)
+        result, _gen, _bins = self._roundtrip(msg)
+        if result != RESULT_OK:
+            raise AerospikeError(result)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,213 @@
+"""A hermetic RabbitMQ lookalike: the AMQP 0-9-1 server subset
+amqp_proto speaks — PLAIN handshake, channel open, queue
+declare/purge, publisher confirms, basic.publish (method + header +
+body frames), basic.get with auto-ack. Queues are FIFO lists of
+base64 bodies in the shared flock store."""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import random
+import socketserver
+import struct
+import sys
+import time
+
+from . import amqp_proto as aq
+from .simbase import Store, build_sim_archive
+
+
+class Handler(socketserver.BaseRequestHandler):
+    store: Store = None  # type: ignore[assignment]
+    mean_latency: float = 0.0
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client went away")
+            buf += chunk
+        return buf
+
+    def _read_frame(self) -> tuple:
+        header = self._read_exact(7)
+        ftype, channel, size = struct.unpack(">BHI", header)
+        payload = self._read_exact(size)
+        self._read_exact(1)
+        return ftype, channel, payload
+
+    def _send_frame(self, ftype: int, channel: int,
+                    payload: bytes) -> None:
+        self.request.sendall(struct.pack(">BHI", ftype, channel,
+                                         len(payload))
+                             + payload + bytes([aq.FRAME_END]))
+
+    def _send_method(self, channel: int, cm: tuple,
+                     args: bytes = b"") -> None:
+        self._send_frame(aq.FRAME_METHOD, channel,
+                         struct.pack(">HH", *cm) + args)
+
+    def handle(self):
+        self.request.settimeout(120.0)
+        confirms = False
+        publish_seq = 0
+        try:
+            if self._read_exact(8) != b"AMQP\x00\x00\x09\x01":
+                return
+            self._send_method(0, aq.CONN_START,
+                              struct.pack(">BB", 0, 9)
+                              + struct.pack(">I", 0)
+                              + aq.longstr(b"PLAIN")
+                              + aq.longstr(b"en_US"))
+            self._read_frame()  # start-ok: accept anyone
+            self._send_method(0, aq.CONN_TUNE,
+                              struct.pack(">HIH", 0, 131072, 0))
+            self._read_frame()  # tune-ok
+            self._read_frame()  # open
+            self._send_method(0, aq.CONN_OPEN_OK, aq.shortstr(""))
+
+            while True:
+                ftype, channel, payload = self._read_frame()
+                if ftype != aq.FRAME_METHOD:
+                    continue
+                cm = struct.unpack_from(">HH", payload)
+                args = payload[4:]
+                if self.mean_latency > 0:
+                    time.sleep(random.expovariate(1.0 / self.mean_latency))
+                if cm == aq.CH_OPEN:
+                    self._send_method(channel, aq.CH_OPEN_OK,
+                                      struct.pack(">I", 0))
+                elif cm == aq.Q_DECLARE:
+                    queue, _ = aq.read_shortstr(args, 2)
+
+                    def declare(data):
+                        queues = dict(data.get("queues") or {})
+                        if queue not in queues:
+                            queues[queue] = []
+                            new = dict(data)
+                            new["queues"] = queues
+                            return None, new
+                        return None, None
+
+                    self.store.transact(declare)
+                    self._send_method(channel, aq.Q_DECLARE_OK,
+                                      aq.shortstr(queue)
+                                      + struct.pack(">II", 0, 0))
+                elif cm == aq.Q_PURGE:
+                    queue, _ = aq.read_shortstr(args, 2)
+
+                    def purge(data):
+                        queues = dict(data.get("queues") or {})
+                        n = len(queues.get(queue) or [])
+                        queues[queue] = []
+                        new = dict(data)
+                        new["queues"] = queues
+                        return n, new
+
+                    n = self.store.transact(purge)
+                    self._send_method(channel, aq.Q_PURGE_OK,
+                                      struct.pack(">I", n))
+                elif cm == aq.CONFIRM_SELECT:
+                    confirms = True
+                    self._send_method(channel, aq.CONFIRM_SELECT_OK)
+                elif cm == aq.BASIC_PUBLISH:
+                    pos = 2
+                    _exchange, pos = aq.read_shortstr(args, pos)
+                    routing_key, pos = aq.read_shortstr(args, pos)
+                    ftype, _ch, header = self._read_frame()
+                    _cls, _w, size = struct.unpack_from(">HHQ", header)
+                    body = b""
+                    while len(body) < size:
+                        ftype, _ch, chunk = self._read_frame()
+                        body += chunk
+
+                    def enqueue(data):
+                        queues = dict(data.get("queues") or {})
+                        queues[routing_key] = (
+                            list(queues.get(routing_key) or [])
+                            + [base64.b64encode(body).decode()])
+                        new = dict(data)
+                        new["queues"] = queues
+                        return None, new
+
+                    self.store.transact(enqueue)
+                    if confirms:
+                        publish_seq += 1
+                        self._send_method(
+                            channel, aq.BASIC_ACK,
+                            struct.pack(">QB", publish_seq, 0))
+                elif cm == aq.BASIC_GET:
+                    queue, _ = aq.read_shortstr(args, 2)
+
+                    def take(data):
+                        queues = dict(data.get("queues") or {})
+                        q = list(queues.get(queue) or [])
+                        if not q:
+                            return None, None
+                        head, rest = q[0], q[1:]
+                        queues[queue] = rest
+                        new = dict(data)
+                        new["queues"] = queues
+                        return head, new
+
+                    got = self.store.transact(take)
+                    if got is None:
+                        self._send_method(channel, aq.BASIC_GET_EMPTY,
+                                          aq.shortstr(""))
+                    else:
+                        body = base64.b64decode(got)
+                        self._send_method(
+                            channel, aq.BASIC_GET_OK,
+                            struct.pack(">QB", 1, 0)
+                            + aq.shortstr("") + aq.shortstr(queue)
+                            + struct.pack(">I", 0))
+                        self._send_frame(
+                            aq.FRAME_HEADER, channel,
+                            struct.pack(">HHQ", 60, 0, len(body))
+                            + struct.pack(">H", 0))
+                        self._send_frame(aq.FRAME_BODY, channel, body)
+                elif cm == aq.CONN_CLOSE:
+                    return
+        except (ConnectionError, TimeoutError, OSError, struct.error):
+            return
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description="rabbitmq AMQP sim",
+                                allow_abbrev=False)
+    p.add_argument("--data", required=True)
+    p.add_argument("--mean-latency", type=float, default=0.0)
+    p.add_argument("--port", type=int, default=5672)
+    p.add_argument("--name", default="sim")
+    return p.parse_args(argv)
+
+
+def serve(argv=None) -> None:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    Handler.store = Store(args.data)
+    Handler.mean_latency = args.mean_latency
+    srv = Server(("127.0.0.1", args.port), Handler)
+    print(f"amqp-sim {args.name} serving on {args.port}, "
+          f"data={args.data}")
+    sys.stdout.flush()
+    srv.serve_forever()
+
+
+def build_archive(dest: str, data_path: str, mean_latency: float = 0.0,
+                  python: str | None = None) -> str:
+    return build_sim_archive(
+        dest, "jepsen_tpu.dbs.amqp_sim", "rabbitmq-server",
+        "rabbitmq-sim", data_path, mean_latency=mean_latency,
+        python=python,
+    )
+
+
+if __name__ == "__main__":
+    serve()

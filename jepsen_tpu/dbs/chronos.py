@@ -1,0 +1,309 @@
+"""Chronos test suite: schedule repeating jobs, record their actual
+runs, and verify every promised execution happened within its window
+(reference: /root/reference/chronos/src/jepsen/chronos.clj:1-270 and
+chronos/checker.clj:1-321).
+
+Jobs are shell commands that log their own invocation times to
+tempfiles on the node (chronos.clj:109-117); the final :read collects
+those run files from every node via the control plane
+(chronos.clj:161-172). The checker derives each job's target windows
+[t, t+epsilon+forgiveness] from its schedule and greedily matches runs
+to targets — a target with no run is a missed execution
+(checker.clj:30-90's model, with a greedy matcher in place of the
+reference's constraint solver)."""
+
+from __future__ import annotations
+
+import datetime
+import itertools
+import json
+import logging
+import random
+import socket
+import time
+import urllib.error
+import urllib.request
+
+from .. import checker as checker_mod
+from .. import cli, client, generator as gen, nemesis, osdist
+from ..checker import Checker
+from ..history import Op, ops as _ops
+from ..util import real_pmap
+from .common import ArchiveDB, SuiteCfg
+
+log = logging.getLogger("jepsen_tpu.dbs.chronos")
+
+PORT = 4400
+EPSILON_FORGIVENESS = 5  # let chronos miss deadlines by a few seconds
+
+
+_suite = SuiteCfg("chronos", PORT, "/opt/chronos")
+node_host = _suite.host
+node_port = _suite.port
+
+
+def job_dir(test) -> str:
+    return _suite.cfg(test).get("job_dir", "/tmp/chronos-test")
+
+
+class ChronosDB(ArchiveDB):
+    binary = "chronos"
+    log_name = "chronos.log"
+    pid_name = "chronos.pid"
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 60.0):
+        super().__init__(_suite, archive_url, ready_timeout)
+
+    def daemon_args(self, test, node) -> list:
+        return ["--port", str(node_port(test, node))]
+
+    def probe_ready(self, test, node) -> bool:
+        url = (f"http://{node_host(test, node)}:{node_port(test, node)}"
+               "/scheduler/jobs")
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return resp.status == 200
+
+    def setup(self, test, node) -> None:
+        test["remote"].exec(node, ["mkdir", "-p", job_dir(test)],
+                            check=False)
+        super().setup(test, node)
+
+    def teardown(self, test, node) -> None:
+        super().teardown(test, node)
+        test["remote"].exec(node, ["rm", "-rf", job_dir(test)],
+                            check=False)
+
+
+def interval_str(job: dict) -> str:
+    """R<count>/<ISO start>/PT<interval>S (chronos.clj:102-107)."""
+    start = datetime.datetime.fromtimestamp(
+        job["start"], tz=datetime.timezone.utc)
+    return (f"R{job['count']}/{start.isoformat()}"
+            f"/PT{job['interval']}S")
+
+
+def command(job: dict, test) -> str:
+    """Shell command logging name + invocation + completion times
+    (chronos.clj:109-117)."""
+    d = job_dir(test)
+    return (f"MEW=$(mktemp -p {d}); "
+            f"echo \"{job['name']}\" >> $MEW; "
+            "date -u +%s.%N >> $MEW; "
+            f"sleep {job['duration']}; "
+            "date -u +%s.%N >> $MEW;")
+
+
+def job_to_json(job: dict, test) -> dict:
+    return {
+        "name": str(job["name"]),
+        "command": command(job, test),
+        "schedule": interval_str(job),
+        "scheduleTimeZone": "UTC",
+        "owner": "jepsen@jepsen.io",
+        "epsilon": f"PT{job['epsilon']}S",
+        "mem": 1, "disk": 1, "cpus": 0.001, "async": False,
+    }
+
+
+def read_runs(test) -> list:
+    """Collect every run record from every node's job files
+    (chronos.clj:143-172)."""
+    remote = test["remote"]
+    d = job_dir(test)
+
+    def read_node(node):
+        out = remote.exec(
+            node, f"cat {d}/* 2>/dev/null || true", check=False).out
+        runs = []
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        for i in range(0, len(lines) - 1, 3):
+            try:
+                runs.append({
+                    "node": str(node),
+                    "name": int(lines[i]),
+                    "start": float(lines[i + 1]),
+                    "end": (float(lines[i + 2])
+                            if i + 2 < len(lines) else None),
+                })
+            except ValueError:
+                continue
+        return runs
+
+    out = []
+    for runs in real_pmap(read_node, test["nodes"]):
+        out.extend(runs)
+    return out
+
+
+class ChronosClient(client.Client):
+    """add-job POSTs the schedule; read collects run files
+    (chronos.clj:174-196)."""
+
+    def __init__(self, node=None):
+        self.node = node
+
+    def open(self, test, node):
+        return ChronosClient(node)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add-job":
+                body = json.dumps(job_to_json(op.value, test)).encode()
+                req = urllib.request.Request(
+                    f"http://{node_host(test, self.node)}:"
+                    f"{node_port(test, self.node)}/scheduler/iso8601",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=20):
+                    pass
+                return op.with_(type="ok")
+            if op.f == "read":
+                return op.with_(type="ok", value=read_runs(test))
+            raise ValueError(f"unknown op {op.f!r}")
+        except (ConnectionError, socket.timeout, TimeoutError) as e:
+            return op.with_(type="fail", error=str(e))
+        except (urllib.error.URLError, OSError) as e:
+            return op.with_(type="fail", error=str(e))
+
+
+class ChronosChecker(Checker):
+    """Match runs to each job's target windows (checker.clj:30-199,
+    greedy instead of loco). A job's targets are every scheduled start
+    before the final read (minus epsilon+duration slack); each needs a
+    run beginning within [target, target+epsilon+forgiveness]."""
+
+    def check(self, test, history, opts=None) -> dict:
+        jobs = [o.value for o in _ops(history)
+                if o.is_ok and o.f == "add-job"]
+        read_time = None
+        runs = None
+        for o in _ops(history):
+            if o.is_ok and o.f == "read":
+                runs = o.value
+                read_time = (o.time or 0) / 1e9 if o.time else None
+        if runs is None:
+            return {"valid": "unknown", "error": "no run read"}
+        if read_time is None:
+            read_time = time.time()
+
+        runs_by_job: dict = {}
+        for run in runs:
+            runs_by_job.setdefault(run["name"], []).append(run)
+
+        job_results = {}
+        all_valid = True
+        for job in jobs:
+            targets = []
+            finish = read_time - job["epsilon"] - job["duration"]
+            for i in range(job["count"]):
+                t = job["start"] + i * job["interval"]
+                if t > finish:
+                    break
+                targets.append(t)
+            available = sorted(
+                r["start"] for r in runs_by_job.get(job["name"], []))
+            used = [False] * len(available)
+            solo = []
+            for t in targets:
+                hit = None
+                for i, s in enumerate(available):
+                    if used[i]:
+                        continue
+                    if t <= s <= t + job["epsilon"] + EPSILON_FORGIVENESS:
+                        hit = i
+                        break
+                if hit is None:
+                    solo.append(t)
+                else:
+                    used[hit] = True
+            extra = used.count(False)
+            ok = not solo
+            all_valid = all_valid and ok
+            job_results[job["name"]] = {
+                "valid": ok,
+                "targets": len(targets),
+                "runs": len(available),
+                "missed_targets": solo[:10],
+                "extra_runs": extra,
+            }
+        return {"valid": all_valid, "jobs": job_results}
+
+
+def add_job_gen():
+    """Non-overlapping repeating jobs a few seconds out
+    (chronos.clj:194-217)."""
+    ids = itertools.count(1)
+
+    def g(test, process):
+        head_start = test.get("chronos_head_start", 10)
+        duration = random.randrange(test.get("chronos_max_duration", 10))
+        epsilon = 10 + random.randrange(20)
+        interval = (1 + duration + epsilon + EPSILON_FORGIVENESS
+                    + random.randrange(30))
+        return {
+            "type": "invoke",
+            "f": "add-job",
+            "value": {
+                "name": next(ids),
+                "start": time.time() + head_start,
+                "count": 1 + random.randrange(
+                    test.get("chronos_max_count", 99)),
+                "duration": duration,
+                "epsilon": epsilon,
+                "interval": interval,
+            },
+        }
+
+    return g
+
+
+def chronos_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": "chronos",
+            "os": osdist.debian,
+            "db": ChronosDB(archive_url=opts.get("archive_url")),
+            "client": ChronosClient(),
+            "nemesis": nemesis.partition_random_halves(),
+            "generator": gen.phases(
+                gen.time_limit(
+                    opts.get("time_limit", 120),
+                    gen.nemesis(
+                        gen.start_stop(20, 20),
+                        gen.stagger(opts.get("stagger", 5),
+                                    add_job_gen()),
+                    ),
+                ),
+                gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+                gen.sleep(opts.get("quiesce", 15)),
+                gen.clients(gen.once({"type": "invoke", "f": "read"})),
+            ),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "chronos": ChronosChecker(),
+            }),
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--archive-url", dest="archive_url", default=None)
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(chronos_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""A hermetic Chronos lookalike: the scheduler API subset the chronos
+suite drives — POST /scheduler/iso8601 with an R<count>/<start>/PT<n>S
+repeating schedule, GET /scheduler/jobs — and, crucially, it actually
+RUNS each job's shell command at the scheduled times (with bash, like
+real Chronos executes on Mesos agents), so the suite's read-runs path
+(parsing the run files jobs write) works identically against the sim
+and a real cluster."""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import random
+import re
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .simbase import Store, build_sim_archive
+
+
+def parse_iso8601_interval(s: str) -> tuple:
+    """R<count>/<start>/PT<interval>S -> (count, start_epoch,
+    interval_s)."""
+    m = re.fullmatch(r"R(\d*)/([^/]+)/PT(\d+(?:\.\d+)?)S", s)
+    if not m:
+        raise ValueError(f"bad schedule {s!r}")
+    count = int(m.group(1)) if m.group(1) else 1 << 30
+    start = datetime.datetime.fromisoformat(
+        m.group(2).replace("Z", "+00:00")).timestamp()
+    return count, start, float(m.group(3))
+
+
+class Runner(threading.Thread):
+    """Executes one job's command at each scheduled time."""
+
+    def __init__(self, job: dict):
+        super().__init__(daemon=True)
+        self.job = job
+
+    def run(self):
+        count, start, interval = parse_iso8601_interval(
+            self.job["schedule"])
+        for i in range(count):
+            target = start + i * interval
+            delay = target - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                subprocess.run(["bash", "-c", self.job["command"]],
+                               timeout=300)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+
+
+class Handler(BaseHTTPRequestHandler):
+    store: Store = None  # type: ignore[assignment]
+    mean_latency: float = 0.0
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        sys.stdout.write("%s - %s\n" % (self.address_string(), fmt % args))
+        sys.stdout.flush()
+
+    def _reply(self, status: int, body) -> None:
+        payload = (body if isinstance(body, bytes)
+                   else json.dumps(body).encode())
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_POST(self):
+        if self.mean_latency > 0:
+            time.sleep(random.expovariate(1.0 / self.mean_latency))
+        if not self.path.startswith("/scheduler/iso8601"):
+            return self._reply(404, {"error": "no route"})
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            job = json.loads(self.rfile.read(length))
+            parse_iso8601_interval(job["schedule"])  # validate
+        except (json.JSONDecodeError, KeyError, ValueError) as e:
+            return self._reply(400, {"error": str(e)})
+
+        def record(data):
+            jobs = dict(data.get("jobs") or {})
+            jobs[str(job["name"])] = job
+            new = dict(data)
+            new["jobs"] = jobs
+            return None, new
+
+        self.store.transact(record)
+        Runner(job).start()
+        self._reply(204, b"")
+
+    def do_GET(self):
+        if not self.path.startswith("/scheduler/jobs"):
+            return self._reply(404, {"error": "no route"})
+
+        def read(data):
+            return list((data.get("jobs") or {}).values()), None
+
+        self._reply(200, self.store.transact(read))
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description="chronos scheduler sim",
+                                allow_abbrev=False)
+    p.add_argument("--data", required=True)
+    p.add_argument("--mean-latency", type=float, default=0.0)
+    p.add_argument("--port", type=int, default=4400)
+    p.add_argument("--name", default="sim")
+    p.add_argument("--master", default=None)  # mesos flag, tolerated
+    return p.parse_args(argv)
+
+
+def serve(argv=None) -> None:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    Handler.store = Store(args.data)
+    Handler.mean_latency = args.mean_latency
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    print(f"chronos-sim {args.name} serving on {args.port}, "
+          f"data={args.data}")
+    sys.stdout.flush()
+    httpd.serve_forever()
+
+
+def build_archive(dest: str, data_path: str, mean_latency: float = 0.0,
+                  python: str | None = None) -> str:
+    return build_sim_archive(
+        dest, "jepsen_tpu.dbs.chronos_sim", "chronos", "chronos-sim",
+        data_path, mean_latency=mean_latency, python=python,
+    )
+
+
+if __name__ == "__main__":
+    serve()

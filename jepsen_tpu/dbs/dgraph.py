@@ -1,0 +1,261 @@
+"""Dgraph test suite: set and upsert workloads over the HTTP API
+(reference: /root/reference/dgraph/src/jepsen/dgraph/{core,client,set,
+upsert}.clj — the reference drives dgraph4j over gRPC; this speaks the
+HTTP mutate/query API, dgraph's other first-class surface).
+
+Workloads:
+  - set: integers as nodes with a value predicate; final read queries
+    has(value) — every acknowledged add must appear (set.clj:20-53)
+  - upsert: concurrent insert-if-absent of the same key via an upsert
+    block (query + cond); under snapshot isolation at most ONE insert
+    per key may win (upsert.clj:20-68)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import socket
+import urllib.error
+import urllib.request
+
+from .. import checker as checker_mod
+from .. import cli, client, generator as gen, independent, nemesis, osdist
+from ..checker import Checker
+from ..history import Op, ops as _ops
+from .common import ArchiveDB, SuiteCfg
+
+log = logging.getLogger("jepsen_tpu.dbs.dgraph")
+
+PORT = 8080
+
+
+_suite = SuiteCfg("dgraph", PORT, "/opt/dgraph")
+node_host = _suite.host
+node_port = _suite.port
+
+
+class DgraphDB(ArchiveDB):
+    """dgraph alpha per node, pointed at the first node's zero
+    (dgraph/support.clj's cluster bring-up)."""
+
+    binary = "dgraph"
+    log_name = "dgraph.log"
+    pid_name = "dgraph.pid"
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 60.0):
+        super().__init__(_suite, archive_url, ready_timeout)
+
+    def daemon_args(self, test, node) -> list:
+        primary = test["nodes"][0]
+        return ["--port", str(node_port(test, node)),
+                "--zero", f"{node_host(test, primary)}:5080",
+                "--my", f"{node_host(test, node)}:7080"]
+
+    def probe_ready(self, test, node) -> bool:
+        url = (f"http://{node_host(test, node)}:{node_port(test, node)}"
+               "/health")
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return resp.status == 200
+
+
+class DgraphConn:
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def _post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            out = json.load(resp)
+        if out.get("errors"):
+            raise DgraphError(out["errors"][0].get("message", "error"))
+        return out
+
+    def alter(self, schema: str) -> None:
+        self._post("/alter", {"schema": schema})
+
+    def mutate(self, sets: list, query: str | None = None,
+               cond: str | None = None) -> dict:
+        body: dict = {"set": sets}
+        if query is not None:
+            body["query"] = query
+        if cond is not None:
+            body["cond"] = cond
+        return self._post("/mutate", body)["data"]["uids"]
+
+    def query(self, q: str) -> list:
+        return self._post("/query", {"query": q})["data"]["q"]
+
+
+class DgraphError(Exception):
+    pass
+
+
+class SetClient(client.Client):
+    """Adds as fresh nodes (set.clj:20-53)."""
+
+    def __init__(self, conn=None):
+        self.conn = conn
+
+    def open(self, test, node):
+        conn = DgraphConn(node_host(test, node), node_port(test, node))
+        conn.alter("value: int @index(int) .")
+        return SetClient(conn)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.conn.mutate([{"type": "element",
+                                   "value": op.value}])
+                return op.with_(type="ok")
+            if op.f == "read":
+                rows = self.conn.query(
+                    "{ q(func: has(value)) { uid value } }")
+                return op.with_(
+                    type="ok",
+                    value=sorted(r["value"] for r in rows
+                                 if "value" in r))
+            raise ValueError(f"unknown op {op.f!r}")
+        except (DgraphError, socket.timeout, TimeoutError,
+                urllib.error.URLError, OSError) as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error=str(e))
+
+    def close(self, test):
+        pass
+
+
+class UpsertClient(client.Client):
+    """Insert-if-absent races via an upsert block (upsert.clj:20-50):
+    each txn queries for the key and inserts only when absent."""
+
+    def __init__(self, conn=None):
+        self.conn = conn
+
+    def open(self, test, node):
+        conn = DgraphConn(node_host(test, node), node_port(test, node))
+        conn.alter("key: int @index(int) @upsert .")
+        return UpsertClient(conn)
+
+    def invoke(self, test, op: Op) -> Op:
+        k = op.value
+        try:
+            if op.f == "upsert":
+                uids = self.conn.mutate(
+                    [{"key": k}],
+                    query=f"{{ v(func: eq(key, {k})) {{ uid }} }}",
+                    cond="@if(eq(len(v), 0))",
+                )
+                # no uids assigned => the cond failed => lost the race
+                return op.with_(type="ok" if uids else "fail",
+                                error=None if uids else "already-exists")
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"{{ q(func: eq(key, {k})) {{ uid }} }}")
+                return op.with_(type="ok",
+                                value=[r["uid"] for r in rows])
+            raise ValueError(f"unknown op {op.f!r}")
+        except (DgraphError, socket.timeout, TimeoutError,
+                urllib.error.URLError, OSError) as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error=str(e))
+
+    def close(self, test):
+        pass
+
+
+class UpsertChecker(Checker):
+    """At most one upsert per key may succeed, and the final read must
+    show at most one uid (upsert.clj:53-68)."""
+
+    def check(self, test, history, opts=None) -> dict:
+        ok_upserts: dict = {}
+        for o in _ops(history):
+            if o.f == "upsert" and o.is_ok:
+                ok_upserts[o.value] = ok_upserts.get(o.value, 0) + 1
+        multi = {k: n for k, n in ok_upserts.items() if n > 1}
+        return {"valid": not multi, "multiple_upserts": multi}
+
+
+def workloads(opts: dict) -> dict:
+    return {
+        "set": {
+            "client": SetClient(),
+            "during": gen.stagger(
+                opts.get("stagger", 0.05),
+                gen.seq({"type": "invoke", "f": "add", "value": x}
+                        for x in itertools.count())),
+            "final": gen.clients(gen.each(
+                lambda: gen.once({"type": "invoke", "f": "read"}))),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "set": checker_mod.set_checker(),
+            }),
+        },
+        "upsert": {
+            "client": UpsertClient(),
+            # every process races to upsert the same keys
+            "during": gen.seq(
+                gen.each(lambda k=k: gen.once(
+                    {"type": "invoke", "f": "upsert", "value": k}))
+                for k in range(opts.get("keys", 20))),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "upsert": UpsertChecker(),
+            }),
+        },
+    }
+
+
+def dgraph_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    wl = workloads(opts)[opts.get("workload", "set")]
+    generator = gen.time_limit(
+        opts.get("time_limit", 60),
+        gen.nemesis(gen.start_stop(10, 10), wl["during"]),
+    )
+    if wl.get("final") is not None:
+        generator = gen.phases(
+            generator,
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(opts.get("quiesce", 10)),
+            wl["final"],
+        )
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": f"dgraph {opts.get('workload', 'set')}",
+            "os": osdist.debian,
+            "db": DgraphDB(archive_url=opts.get("archive_url")),
+            "client": wl["client"],
+            "nemesis": nemesis.partition_random_halves(),
+            "generator": generator,
+            "checker": wl["checker"],
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--workload", default="set",
+                   choices=["set", "upsert"])
+    p.add_argument("--archive-url", dest="archive_url", default=None)
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(dgraph_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

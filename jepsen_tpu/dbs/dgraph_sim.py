@@ -1,0 +1,171 @@
+"""A hermetic Dgraph lookalike: the HTTP API subset the dgraph suite
+drives — /alter (schema accepted), /mutate with set-JSON and optional
+upsert query+cond, /query with a tiny DQL subset (func: has(pred) |
+eq(pred, val), fields uid + predicates), /health. Nodes are uid-keyed
+predicate maps in the shared flock store; mutations are atomic under
+the store lock, reproducing a serializable Zero."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import re
+import sys
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .simbase import Store, build_sim_archive
+
+
+def parse_func(query: str) -> tuple:
+    """(func_name, pred, value|None, fields) from the one-block DQL
+    shape `{ q(func: eq(value, 5)) { uid value } }`."""
+    m = re.search(
+        r"func:\s*(\w+)\s*\(\s*(\w+)\s*(?:,\s*([^)\s]+))?\s*\)", query)
+    if not m:
+        raise ValueError(f"can't parse query func: {query!r}")
+    fm = re.search(r"\)\s*\)?\s*\{([^}]*)\}", query)
+    fields = fm.group(1).split() if fm else ["uid"]
+    value = m.group(3)
+    if value is not None:
+        value = value.strip("\"'")
+        try:
+            value = int(value)
+        except ValueError:
+            pass
+    return m.group(1), m.group(2), value, fields
+
+
+def run_query(data: dict, query: str) -> list:
+    func, pred, value, fields = parse_func(query)
+    nodes = data.get("nodes") or {}
+    out = []
+    for uid, preds in nodes.items():
+        if func == "has" and pred not in preds:
+            continue
+        if func == "eq" and preds.get(pred) != value:
+            continue
+        row = {}
+        for f in fields:
+            if f == "uid":
+                row["uid"] = uid
+            elif f in preds:
+                row[f] = preds[f]
+        out.append(row)
+    return out
+
+
+class Handler(BaseHTTPRequestHandler):
+    store: Store = None  # type: ignore[assignment]
+    mean_latency: float = 0.0
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        sys.stdout.write("%s - %s\n" % (self.address_string(), fmt % args))
+        sys.stdout.flush()
+
+    def _reply(self, status: int, body: dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        if urllib.parse.urlparse(self.path).path == "/health":
+            return self._reply(200, {"status": "healthy"})
+        self._reply(404, {"errors": [{"message": "no route"}]})
+
+    def do_POST(self):
+        if self.mean_latency > 0:
+            time.sleep(random.expovariate(1.0 / self.mean_latency))
+        path = urllib.parse.urlparse(self.path).path
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            return self._reply(400, {"errors": [{"message": "bad json"}]})
+        if path == "/alter":
+            return self._reply(200, {"data": {"code": "Success"}})
+        if path == "/query":
+            def rd(data):
+                try:
+                    return run_query(data, body["query"]), None
+                except ValueError as e:
+                    return e, None
+
+            out = self.store.transact(rd)
+            if isinstance(out, Exception):
+                return self._reply(400, {"errors": [{"message": str(out)}]})
+            return self._reply(200, {"data": {"q": out}})
+        if path == "/mutate":
+            return self._mutate(body)
+        self._reply(404, {"errors": [{"message": "no route"}]})
+
+    def _mutate(self, body: dict) -> None:
+        sets = body.get("set") or []
+        upsert_query = body.get("query")
+        cond = body.get("cond")
+
+        def mut(data):
+            nodes = dict(data.get("nodes") or {})
+            if upsert_query is not None:
+                found = run_query(data, upsert_query)
+                if cond is not None:
+                    m = re.search(r"eq\(len\(\w+\),\s*(\d+)\)", cond)
+                    want = int(m.group(1)) if m else 0
+                    if len(found) != want:
+                        return {"data": {"code": "Success",
+                                         "uids": {}}}, None
+            uids = {}
+            counter = int(data.get("uid_counter") or 0)
+            for i, triple in enumerate(sets):
+                counter += 1
+                uid = f"0x{counter:x}"
+                nodes[uid] = {k: v for k, v in triple.items()
+                              if k != "uid"}
+                uids[f"blank-{i}"] = uid
+            new = dict(data)
+            new["nodes"], new["uid_counter"] = nodes, counter
+            return {"data": {"code": "Success", "uids": uids}}, new
+
+        self._reply(200, self.store.transact(mut))
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description="dgraph HTTP sim",
+                                allow_abbrev=False)
+    p.add_argument("--data", required=True)
+    p.add_argument("--mean-latency", type=float, default=0.0)
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--name", default="sim")
+    # dgraph alpha flags tolerated:
+    p.add_argument("--zero", default=None)
+    p.add_argument("--my", default=None)
+    return p.parse_args(argv)
+
+
+def serve(argv=None) -> None:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    Handler.store = Store(args.data)
+    Handler.mean_latency = args.mean_latency
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    print(f"dgraph-sim {args.name} serving on {args.port}, "
+          f"data={args.data}")
+    sys.stdout.flush()
+    httpd.serve_forever()
+
+
+def build_archive(dest: str, data_path: str, mean_latency: float = 0.0,
+                  python: str | None = None) -> str:
+    return build_sim_archive(
+        dest, "jepsen_tpu.dbs.dgraph_sim", "dgraph", "dgraph-sim",
+        data_path, mean_latency=mean_latency, python=python,
+    )
+
+
+if __name__ == "__main__":
+    serve()

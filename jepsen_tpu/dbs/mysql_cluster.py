@@ -2,7 +2,13 @@
 MySQL protocol (reference:
 /root/reference/mysql-cluster/src/jepsen/mysql_cluster.clj:1-227;
 clients live in mysql_common.py). mysqld nodes point at the management
-node (the first node) via --ndb-connectstring."""
+node (the first node) via --ndb-connectstring.
+
+A real NDB deployment is THREE process types (ndb_mgmd + ndbd +
+mysqld, mysql_cluster.clj's bring-up); like the tidb suite, the
+archive's mysqld binary is expected to wrap that bring-up (start
+ndb_mgmd/ndbd when local, then exec mysqld) — the hermetic path runs
+dbs/mysql_sim through the same daemon machinery."""
 
 from __future__ import annotations
 

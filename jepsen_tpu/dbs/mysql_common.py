@@ -362,7 +362,8 @@ def make_sql_suite(name: str, default_port: int, binary: str,
                     return True
                 finally:
                     conn.close()
-            except mp.MySqlError:
+            except (mp.MySqlError, mp.MySqlProtocolError):
+                # a server mid-startup can speak garbage; keep polling
                 return False
 
     DB.__name__ = f"{name.title().replace('-', '')}DB"

@@ -19,6 +19,7 @@ import socket
 import struct
 
 CLIENT_LONG_PASSWORD = 0x00000001
+CLIENT_FOUND_ROWS = 0x00000002
 CLIENT_PROTOCOL_41 = 0x00000200
 CLIENT_SECURE_CONNECTION = 0x00008000
 CLIENT_PLUGIN_AUTH = 0x00080000
@@ -172,9 +173,12 @@ class MySqlConn:
         nonce2 = payload[pos:pos + 12]     # 13 bytes incl NUL; use 12
         nonce = nonce1 + nonce2
 
-        caps = (CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41
-                | CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH
-                | CLIENT_TRANSACTIONS)
+        # CLIENT_FOUND_ROWS: UPDATE reports MATCHED rows, so a CAS
+        # write of an identical value still counts (the JDBC drivers
+        # the reference suites ride set this too)
+        caps = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS
+                | CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
+                | CLIENT_PLUGIN_AUTH | CLIENT_TRANSACTIONS)
         if database:
             caps |= CLIENT_CONNECT_WITH_DB
         auth = scramble_native(password, nonce)
@@ -189,6 +193,20 @@ class MySqlConn:
         payload = self.io.read_packet()
         if payload[0] == 0xFF:
             raise parse_err(payload)
+        if payload[0] == 0xFE:
+            # AuthSwitchRequest (e.g. a server defaulting to
+            # caching_sha2_password): switch to the requested plugin
+            # when it's mysql_native_password, else give up cleanly
+            end = payload.index(b"\x00", 1)
+            plugin = payload[1:end].decode()
+            if plugin != "mysql_native_password":
+                raise MySqlProtocolError(
+                    f"unsupported auth plugin {plugin!r}")
+            new_nonce = payload[end + 1:].rstrip(b"\x00")
+            self.io.write_packet(scramble_native(password, new_nonce))
+            payload = self.io.read_packet()
+            if payload[0] == 0xFF:
+                raise parse_err(payload)
         if payload[0] not in (0x00,):
             raise MySqlProtocolError(
                 f"unexpected auth reply 0x{payload[0]:02x}")

@@ -39,8 +39,6 @@ _SQLSTATE_TO_MYSQL = {
 def _to_mysql_error(e: crdb_sim.SqlError) -> bytes:
     code, msg, state = _SQLSTATE_TO_MYSQL.get(
         e.sqlstate, (mp.ER_PARSE_ERROR, e.message, "42000"))
-    if e.sqlstate not in _SQLSTATE_TO_MYSQL:
-        msg = e.message
     return mp.err_packet(code, msg, state)
 
 

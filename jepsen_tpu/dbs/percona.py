@@ -11,8 +11,14 @@ from .mysql_common import make_sql_suite
 
 
 def _daemon_args(suite, test, node) -> list:
-    gcomm = ",".join(suite.host(test, n) for n in test["nodes"]
-                     if n != node)
+    # the first node bootstraps a NEW cluster (empty gcomm://, the
+    # --wsrep-new-cluster semantics of galera.clj:110-111); the rest
+    # join it — without this a fresh real cluster can never form a
+    # primary component
+    primary = test["nodes"][0]
+    gcomm = ("" if node == primary
+             else ",".join(suite.host(test, n) for n in test["nodes"]
+                           if n != node))
     return ["--port", str(suite.port(test, node)),
             f"--wsrep-cluster-address=gcomm://{gcomm}"]
 

@@ -1,0 +1,169 @@
+"""RabbitMQ test suite: a durable queue driven with confirmed
+publishes and auto-ack gets, checked with total-queue (reference:
+/root/reference/rabbitmq/src/jepsen/rabbitmq.clj:1-263).
+
+The determinacy taxonomy follows the reference: a publish whose
+confirm never arrives is :info (the broker may have it); an empty get
+is a definite :fail :exhausted; values ride the framework codec
+(EDN-in-the-reference, JSON here — rabbitmq.clj:111,157)."""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import time
+
+from .. import checker as checker_mod
+from .. import cli, client, codec, generator as gen, nemesis, osdist
+from ..history import Op
+from . import amqp_proto as aq
+from .common import ArchiveDB, SuiteCfg
+
+log = logging.getLogger("jepsen_tpu.dbs.rabbitmq")
+
+PORT = 5672
+QUEUE = "jepsen.queue"
+
+
+_suite = SuiteCfg("rabbitmq", PORT, "/opt/rabbitmq")
+node_host = _suite.host
+node_port = _suite.port
+
+
+class RabbitMQDB(ArchiveDB):
+    """rabbitmq-server per node (rabbitmq.clj:40-99's apt/cluster
+    bring-up condensed to the archive+daemon path)."""
+
+    binary = "rabbitmq-server"
+    log_name = "rabbitmq.log"
+    pid_name = "rabbitmq.pid"
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 60.0):
+        super().__init__(_suite, archive_url, ready_timeout)
+
+    def daemon_args(self, test, node) -> list:
+        return ["--port", str(node_port(test, node))]
+
+    def probe_ready(self, test, node) -> bool:
+        conn = aq.AmqpConn(node_host(test, node), node_port(test, node),
+                           timeout=2.0, connect_timeout=2.0)
+        conn.close()
+        return True
+
+
+class QueueClient(client.Client):
+    """Confirmed enqueues / auto-ack dequeues / drain
+    (rabbitmq.clj:126-183)."""
+
+    def __init__(self, conn: aq.AmqpConn | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        conn = aq.AmqpConn(node_host(test, node), node_port(test, node))
+        conn.queue_declare(QUEUE, durable=True)
+        conn.confirm_select()
+        return QueueClient(conn)
+
+    def _dequeue(self, op: Op) -> Op:
+        body = self.conn.get(QUEUE)
+        if body is None:
+            return op.with_(type="fail", error="exhausted")
+        return op.with_(type="ok", value=codec.decode(body))
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "enqueue":
+                ok = self.conn.publish(QUEUE, codec.encode(op.value))
+                return op.with_(type="ok" if ok else "fail")
+            if op.f == "dequeue":
+                return self._dequeue(op)
+            if op.f == "drain":
+                values = []
+                deadline = time.monotonic() + 10.0
+                try:
+                    while time.monotonic() < deadline:
+                        body = self.conn.get(QUEUE)
+                        if body is None:
+                            return op.with_(type="ok", value=values)
+                        values.append(codec.decode(body))
+                    return op.with_(type="info", error="drain-timeout",
+                                    value=values)
+                except (aq.AmqpError, ConnectionError, socket.timeout,
+                        TimeoutError, OSError) as e:
+                    # keep what was already auto-acked
+                    return op.with_(type="info", error=str(e),
+                                    value=values)
+            raise ValueError(f"unknown op {op.f!r}")
+        except aq.AmqpError as e:
+            return op.with_(type="info", error=str(e))
+        except (socket.timeout, TimeoutError):
+            return op.with_(type="info", error="timeout")
+        except (ConnectionError, OSError) as e:
+            return op.with_(type="info", error=str(e))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def queue_gen() -> gen.Generator:
+    counter = itertools.count()
+
+    def enqueue(test, process):
+        return {"type": "invoke", "f": "enqueue", "value": next(counter)}
+
+    return gen.mix([enqueue, {"type": "invoke", "f": "dequeue"}])
+
+
+def rabbitmq_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": "rabbitmq queue",
+            "os": osdist.debian,
+            "db": RabbitMQDB(archive_url=opts.get("archive_url")),
+            "client": QueueClient(),
+            "nemesis": nemesis.partition_random_halves(),
+            "generator": gen.phases(
+                gen.time_limit(
+                    opts.get("time_limit", 60),
+                    gen.nemesis(
+                        gen.start_stop(10, 10),
+                        gen.stagger(opts.get("stagger", 1 / 10),
+                                    queue_gen()),
+                    ),
+                ),
+                gen.log("Healing cluster"),
+                gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+                gen.sleep(opts.get("quiesce", 10)),
+                gen.clients(gen.each(
+                    lambda: gen.once({"type": "invoke", "f": "drain"}))),
+            ),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "queue": checker_mod.total_queue(),
+            }),
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--archive-url", dest="archive_url", default=None)
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(rabbitmq_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

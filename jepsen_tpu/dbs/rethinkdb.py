@@ -1,0 +1,201 @@
+"""RethinkDB test suite: document-level compare-and-set over ReQL with
+per-key independence (reference:
+/root/reference/rethinkdb/src/jepsen/rethinkdb.clj and
+rethinkdb/document_cas.clj:1-185).
+
+The CAS is the reference's exact ReQL shape: an update whose FUNC body
+branches on get_field equality and raises r.error("abort") otherwise —
+verdict decided by the reply's replaced/errors counts
+(document_cas.clj:93-107). Reads use get_field with a DEFAULT fallback
+for missing documents; writes insert with conflict=update.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+import socket
+
+from .. import checker as checker_mod
+from .. import cli, client, generator as gen, independent, models
+from .. import nemesis, osdist
+from ..history import Op
+from . import rethink_proto as rp
+from .common import ArchiveDB, SuiteCfg, once, shared_flag
+
+log = logging.getLogger("jepsen_tpu.dbs.rethinkdb")
+
+PORT = 28015
+DB_NAME = "jepsen"
+TBL = "cas"
+
+
+_suite = SuiteCfg("rethinkdb", PORT, "/opt/rethinkdb")
+node_host = _suite.host
+node_port = _suite.port
+
+
+class RethinkDB(ArchiveDB):
+    """rethinkdb daemon per node, joined to the primary
+    (rethinkdb.clj's install/start — `rethinkdb --join primary:29015`)."""
+
+    binary = "rethinkdb"
+    log_name = "rethinkdb.log"
+    pid_name = "rethinkdb.pid"
+
+    def __init__(self, archive_url: str | None = None,
+                 ready_timeout: float = 60.0):
+        super().__init__(_suite, archive_url, ready_timeout)
+
+    def daemon_args(self, test, node) -> list:
+        d = _suite.dir(test, node)
+        args = ["--driver-port", str(node_port(test, node)),
+                "--directory", f"{d}/data"]
+        primary = test["nodes"][0]
+        if node != primary:
+            args += ["--join", f"{node_host(test, primary)}:29015"]
+        return args
+
+    def probe_ready(self, test, node) -> bool:
+        conn = rp.ReqlConn(node_host(test, node), node_port(test, node),
+                           timeout=2.0, connect_timeout=2.0)
+        conn.close()
+        return True
+
+
+class DocumentCasClient(client.Client):
+    """Register per independent key (document_cas.clj:54-110). Reads
+    are idempotent → indeterminate reads remap to :fail (with-errors op
+    #{:read}); writes/cas stay :info on connection trouble."""
+
+    def __init__(self, conn=None, flag=None, read_mode: str = "majority"):
+        self.conn = conn
+        self.flag = flag or shared_flag()
+        self.read_mode = read_mode
+
+    def open(self, test, node):
+        conn = rp.ReqlConn(node_host(test, node), node_port(test, node))
+        me = DocumentCasClient(conn, self.flag, self.read_mode)
+
+        def create():
+            conn.run(rp.db_create(DB_NAME))
+            conn.run(rp.table_create(rp.db(DB_NAME), TBL,
+                                     replicas=len(test["nodes"])))
+
+        once(self.flag, create)
+        return me
+
+    def _table(self):
+        return rp.table(rp.db(DB_NAME), TBL, read_mode=self.read_mode)
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            out = self._invoke(k, v, op)
+        except (rp.ReqlError, socket.timeout, TimeoutError,
+                ConnectionError, OSError) as e:
+            out = op.with_(type="info", error=str(e))
+        if op.f == "read" and out.type == "info":
+            out = out.with_(type="fail")
+        return out
+
+    def _invoke(self, k, v, op: Op) -> Op:
+        row = rp.get(self._table(), k)
+        if op.f == "read":
+            value = self.conn.run(
+                rp.default(rp.get_field(row, "val"), None))
+            return op.with_(type="ok", value=independent.tuple_(k, value))
+        if op.f == "write":
+            self.conn.run(rp.insert(self._table(), {"id": k, "val": v},
+                                    conflict="update"))
+            return op.with_(type="ok")
+        if op.f == "cas":
+            old, new = v
+            res = self.conn.run(rp.update(
+                row,
+                rp.func(1, rp.branch(
+                    rp.eq(rp.get_field(rp.var(1), "val"), old),
+                    {"val": new},
+                    rp.error("abort"),
+                )),
+            ))
+            ok = res.get("errors") == 0 and res.get("replaced") == 1
+            return op.with_(type="ok" if ok else "fail")
+        raise ValueError(f"unknown op {op.f!r}")
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randrange(5)}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": (random.randrange(5), random.randrange(5))}
+
+
+def rethinkdb_test(opts: dict) -> dict:
+    from ..testlib import noop_test
+
+    test = noop_test()
+    test.update(opts)
+    test.update(
+        {
+            "name": "rethinkdb document-cas",
+            "os": osdist.debian,
+            "db": RethinkDB(archive_url=opts.get("archive_url")),
+            "client": DocumentCasClient(
+                read_mode=opts.get("read_mode", "majority")),
+            "nemesis": nemesis.partition_random_halves(),
+            "model": models.CASRegister(),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "indep": independent.checker(checker_mod.compose({
+                    "timeline": checker_mod.timeline_html(),
+                    "linear": checker_mod.linearizable(),
+                })),
+            }),
+            "generator": gen.time_limit(
+                opts.get("time_limit", 60),
+                gen.nemesis(
+                    gen.start_stop(10, 10),
+                    independent.concurrent_generator(
+                        opts.get("threads_per_key", 2),
+                        itertools.count(),
+                        lambda k: gen.limit(
+                            opts.get("ops_per_key", 50),
+                            gen.stagger(opts.get("stagger", 0.05),
+                                        gen.mix([r, w, cas])),
+                        ),
+                    ),
+                ),
+            ),
+        }
+    )
+    return test
+
+
+def _opt_spec(p) -> None:
+    p.add_argument("--archive-url", dest="archive_url", default=None)
+    p.add_argument("--read-mode", dest="read_mode", default="majority",
+                   choices=["single", "majority", "outdated"])
+
+
+def main(argv=None) -> None:
+    cli.main(
+        {**cli.single_test_cmd(rethinkdb_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()},
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

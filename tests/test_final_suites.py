@@ -1,0 +1,454 @@
+"""Suite tests for aerospike (generation-CAS wire), robustirc
+(session/TOPIC set), and logcabin (on-node treeops CAS)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import aerospike, aerospike_proto as ap
+from jepsen_tpu.dbs import aerospike_sim, logcabin, logcabin_sim
+from jepsen_tpu.dbs import robustirc, robustirc_sim
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+# ---------------------------------------------------------------------------
+# aerospike
+
+
+@pytest.fixture
+def as_port(tmp_path):
+    class H(aerospike_sim.Handler):
+        store = aerospike_sim.Store(str(tmp_path / "as.json"))
+        mean_latency = 0.0
+
+    srv = aerospike_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestAerospikeWire:
+    def test_get_put_generation(self, as_port):
+        c = ap.AerospikeConn("127.0.0.1", as_port)
+        assert c.get("k") == (None, None)
+        c.put("k", {"value": 3})
+        generation, bins = c.get("k")
+        assert generation == 1 and bins == {"value": 3}
+        c.put("k", {"value": 4})
+        generation, bins = c.get("k")
+        assert generation == 2 and bins == {"value": 4}
+        c.close()
+
+    def test_generation_equal_write(self, as_port):
+        c = ap.AerospikeConn("127.0.0.1", as_port)
+        c.put("g", {"value": 1})
+        generation, _ = c.get("g")
+        c.put("g", {"value": 2}, expected_generation=generation)
+        with pytest.raises(ap.AerospikeError) as ei:
+            c.put("g", {"value": 9}, expected_generation=generation)
+        assert ei.value.code == ap.RESULT_GENERATION
+        assert c.get("g")[1] == {"value": 2}
+        c.close()
+
+    def test_string_bins(self, as_port):
+        c = ap.AerospikeConn("127.0.0.1", as_port)
+        c.put("s", {"name": "hello"})
+        assert c.get("s")[1] == {"name": "hello"}
+        c.close()
+
+
+class TestAerospikeClients:
+    def _map(self, port):
+        return {"aerospike": {"addr_fn": lambda n: "127.0.0.1",
+                              "ports": {"n1": port}}}
+
+    def test_cas_register(self, as_port):
+        t = self._map(as_port)
+        c = aerospike.CasRegisterClient().open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "read", None)).value is None
+        assert c.invoke(t, Op(0, "invoke", "write", 3)).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "cas", (3, 4))).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "cas", (3, 9))).type == "fail"
+        assert c.invoke(t, Op(0, "invoke", "read", None)).value == 4
+
+    def test_counter(self, as_port):
+        t = self._map(as_port)
+        c = aerospike.CounterClient().open(t, "n1")
+        for _ in range(5):
+            assert c.invoke(t, Op(0, "invoke", "add", 1)).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "read", None)).value == 5
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "as.tar.gz")
+        aerospike_sim.build_archive(archive, str(tmp_path / "s" / "a.json"))
+        t = aerospike.aerospike_test({
+            "workload": "cas-register",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "aerospike": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 4,
+            "stagger": 0.01,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# robustirc
+
+
+@pytest.fixture
+def irc_port(tmp_path):
+    class H(robustirc_sim.Handler):
+        store = robustirc_sim.Store(str(tmp_path / "irc.json"))
+        mean_latency = 0.0
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestRobustIrc:
+    def _map(self, port):
+        return {"robustirc": {"addr_fn": lambda n: "127.0.0.1",
+                              "ports": {"n1": port}}}
+
+    def test_session_and_messages(self, irc_port):
+        t = self._map(irc_port)
+        s = robustirc.RobustSession(t, "n1")
+        s.post_message("NICK a")
+        s.post_message("TOPIC #jepsen :7")
+        msgs = s.read_all()
+        assert any(m["Data"] == "TOPIC #jepsen :7" for m in msgs)
+
+    def test_duplicate_message_ids_deduplicated(self, irc_port):
+        t = self._map(irc_port)
+        s = robustirc.RobustSession(t, "n1")
+        s._request("POST", f"/{s.session_id}/message",
+                   body={"Data": "TOPIC #jepsen :1",
+                         "ClientMessageId": 42}, auth=True)
+        s._request("POST", f"/{s.session_id}/message",
+                   body={"Data": "TOPIC #jepsen :1",
+                         "ClientMessageId": 42}, auth=True)
+        topics = [m for m in s.read_all()
+                  if m["Data"].startswith("TOPIC")]
+        assert len(topics) == 1
+
+    def test_set_client(self, irc_port):
+        t = self._map(irc_port)
+        c = robustirc.SetClient().open(t, "n1")
+        for v in (1, 2, 3):
+            assert c.invoke(t, Op(0, "invoke", "add", v)).type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r.type == "ok" and r.value == [1, 2, 3]
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "irc.tar.gz")
+        robustirc_sim.build_archive(archive, str(tmp_path / "s" / "i.json"))
+        t = robustirc.robustirc_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "robustirc": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 2,
+            "time_limit": 3,
+            "quiesce": 0.2,
+            "stagger": 0.02,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# logcabin
+
+
+class TestLogCabin:
+    def _cluster(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "lc.tar.gz")
+        logcabin_sim.build_archive(archive,
+                                   str(tmp_path / "s" / "lc.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        }
+        return nodes, remote, archive, cfg
+
+    def test_treeops_cli_contract(self, tmp_path):
+        nodes, remote, archive, cfg = self._cluster(tmp_path)
+        database = logcabin.LogCabinDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "logcabin": cfg}
+        try:
+            for n in nodes:
+                database.setup(test, n)
+            # write / read round-trip
+            logcabin.treeops(test, "n1", "write", "/k", stdin="5")
+            assert logcabin.treeops(test, "n2", "read", "/k").out == "5"
+            # conditional write: success and CAS-failed
+            d = cfg["dir"]("n1")
+            ok = remote.exec(
+                "n1", [f"{d}/treeops", "-c", "x", "-q", "-t", "5",
+                       "-p", "/k:5", "write", "/k"],
+                stdin="6", check=False)
+            assert ok.ok
+            bad = remote.exec(
+                "n1", [f"{d}/treeops", "-c", "x", "-q", "-t", "5",
+                       "-p", "/k:5", "write", "/k"],
+                stdin="7", check=False)
+            assert not bad.ok and "CAS failed" in bad.err
+            assert logcabin.treeops(test, "n1", "read", "/k").out == "6"
+        finally:
+            for n in nodes:
+                database.teardown(test, n)
+
+    def test_cas_client(self, tmp_path):
+        nodes, remote, archive, cfg = self._cluster(tmp_path)
+        database = logcabin.LogCabinDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "logcabin": cfg}
+        try:
+            for n in nodes:
+                database.setup(test, n)
+            c = logcabin.CASClient().open(test, "n1")
+            assert c.invoke(test, Op(0, "invoke", "read", None)
+                            ).value is None
+            assert c.invoke(test, Op(0, "invoke", "write", 3)
+                            ).type == "ok"
+            assert c.invoke(test, Op(0, "invoke", "cas", (3, 4))
+                            ).type == "ok"
+            assert c.invoke(test, Op(0, "invoke", "cas", (3, 9))
+                            ).type == "fail"
+            assert c.invoke(test, Op(0, "invoke", "read", None)
+                            ).value == 4
+        finally:
+            for n in nodes:
+                database.teardown(test, n)
+
+    def test_full_run(self, tmp_path):
+        nodes, remote, archive, cfg = self._cluster(tmp_path)
+        t = logcabin.logcabin_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "logcabin": cfg,
+            "concurrency": 2,
+            "time_limit": 4,
+            "stagger": 0.05,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# dgraph
+
+
+@pytest.fixture
+def dgraph_port(tmp_path):
+    from jepsen_tpu.dbs import dgraph_sim
+
+    class H(dgraph_sim.Handler):
+        store = dgraph_sim.Store(str(tmp_path / "dg.json"))
+        mean_latency = 0.0
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestDgraph:
+    def _map(self, port):
+        return {"dgraph": {"addr_fn": lambda n: "127.0.0.1",
+                           "ports": {"n1": port}}}
+
+    def test_set_client(self, dgraph_port):
+        from jepsen_tpu.dbs import dgraph
+
+        t = self._map(dgraph_port)
+        c = dgraph.SetClient().open(t, "n1")
+        for v in (3, 1, 2):
+            assert c.invoke(t, Op(0, "invoke", "add", v)).type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert r.type == "ok" and r.value == [1, 2, 3]
+
+    def test_upsert_races_one_winner(self, dgraph_port):
+        from jepsen_tpu.dbs import dgraph
+
+        t = self._map(dgraph_port)
+        c1 = dgraph.UpsertClient().open(t, "n1")
+        c2 = dgraph.UpsertClient().open(t, "n1")
+        r1 = c1.invoke(t, Op(0, "invoke", "upsert", 7))
+        r2 = c2.invoke(t, Op(1, "invoke", "upsert", 7))
+        assert sorted([r1.type, r2.type]) == ["fail", "ok"]
+        read = c1.invoke(t, Op(0, "invoke", "read", 7))
+        assert len(read.value) == 1
+
+    def test_upsert_checker(self):
+        from jepsen_tpu.dbs import dgraph
+
+        good = [Op(0, "invoke", "upsert", 1, index=0),
+                Op(0, "ok", "upsert", 1, index=1),
+                Op(1, "invoke", "upsert", 1, index=2),
+                Op(1, "fail", "upsert", 1, index=3)]
+        assert dgraph.UpsertChecker().check({}, good, {})["valid"] is True
+        bad = good[:3] + [Op(1, "ok", "upsert", 1, index=3)]
+        res = dgraph.UpsertChecker().check({}, bad, {})
+        assert res["valid"] is False and res["multiple_upserts"] == {1: 2}
+
+    def test_full_run_set(self, tmp_path):
+        from jepsen_tpu.dbs import dgraph, dgraph_sim
+
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "dg.tar.gz")
+        dgraph_sim.build_archive(archive, str(tmp_path / "s" / "d.json"))
+        t = dgraph.dgraph_test({
+            "workload": "set",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "dgraph": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 3,
+            "quiesce": 0.2,
+            "stagger": 0.02,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# rabbitmq
+
+
+@pytest.fixture
+def amqp_port(tmp_path):
+    from jepsen_tpu.dbs import amqp_sim
+
+    class H(amqp_sim.Handler):
+        store = amqp_sim.Store(str(tmp_path / "amqp.json"))
+        mean_latency = 0.0
+
+    srv = amqp_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestRabbitMQ:
+    def _map(self, port):
+        return {"rabbitmq": {"addr_fn": lambda n: "127.0.0.1",
+                             "ports": {"n1": port}}}
+
+    def test_amqp_roundtrip(self, amqp_port):
+        from jepsen_tpu.dbs import amqp_proto as aq
+
+        c = aq.AmqpConn("127.0.0.1", amqp_port)
+        c.queue_declare("q", durable=True)
+        c.confirm_select()
+        assert c.publish("q", b"one") is True
+        assert c.publish("q", b"two") is True
+        assert c.get("q") == b"one"
+        assert c.get("q") == b"two"
+        assert c.get("q") is None
+        assert c.queue_purge("q") == 0
+        c.close()
+
+    def test_queue_client(self, amqp_port):
+        from jepsen_tpu.dbs import rabbitmq
+
+        t = self._map(amqp_port)
+        c = rabbitmq.QueueClient().open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "enqueue", 5)).type == "ok"
+        d = c.invoke(t, Op(0, "invoke", "dequeue", None))
+        assert d.type == "ok" and d.value == 5
+        e = c.invoke(t, Op(0, "invoke", "dequeue", None))
+        assert e.type == "fail" and e.error == "exhausted"
+        for v in (1, 2):
+            c.invoke(t, Op(0, "invoke", "enqueue", v))
+        drained = c.invoke(t, Op(0, "invoke", "drain", None))
+        assert drained.type == "ok" and drained.value == [1, 2]
+
+    def test_full_run(self, tmp_path):
+        from jepsen_tpu.dbs import amqp_sim, rabbitmq
+
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "amqp.tar.gz")
+        amqp_sim.build_archive(archive, str(tmp_path / "s" / "q.json"))
+        t = rabbitmq.rabbitmq_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "rabbitmq": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 8,
+            "quiesce": 0.3,
+            "stagger": 0.02,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        t["generator"] = gen.phases(
+            gen.time_limit(8, gen.clients(
+                gen.limit(120, gen.stagger(0.01, rabbitmq.queue_gen())))),
+            gen.sleep(0.3),
+            gen.clients(gen.each(
+                lambda: gen.once({"type": "invoke", "f": "drain"}))),
+        )
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]

@@ -1,0 +1,253 @@
+"""Suite tests for mongodb (OP_MSG document CAS + transfers),
+rethinkdb (ReQL document CAS), and chronos (scheduled-job targets)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, independent, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import bson, chronos, chronos_sim, mongo_proto
+from jepsen_tpu.dbs import mongo_sim, mongodb, rethink_proto as rp
+from jepsen_tpu.dbs import rethink_sim, rethinkdb
+from jepsen_tpu.history import Op
+from tests.helpers import free_port
+
+
+class TestBson:
+    def test_roundtrip(self):
+        doc = {"a": 1, "b": "hi", "c": None, "d": True, "e": 2.5,
+               "f": {"g": [1, "x", None]}, "big": 1 << 40}
+        out, pos = bson.decode(bson.encode(doc))
+        assert out == doc
+
+
+@pytest.fixture
+def mongo_port(tmp_path):
+    class H(mongo_sim.Handler):
+        store = mongo_sim.Store(str(tmp_path / "mongo.json"))
+        mean_latency = 0.0
+
+    srv = mongo_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestMongo:
+    def test_commands(self, mongo_port):
+        c = mongo_proto.MongoConn("127.0.0.1", mongo_port)
+        c.command("admin", {"ping": 1})
+        assert c.insert("db", "c", [{"_id": 1, "value": 5}])["n"] == 1
+        assert c.find_one("db", "c", {"_id": 1})["value"] == 5
+        assert c.find_one("db", "c", {"_id": 9}) is None
+        # conditional update: n reports matches
+        assert c.update("db", "c", {"_id": 1, "value": 5},
+                        {"_id": 1, "value": 6})["n"] == 1
+        assert c.update("db", "c", {"_id": 1, "value": 5},
+                        {"_id": 1, "value": 7})["n"] == 0
+        # upsert
+        assert c.update("db", "c", {"_id": 2},
+                        {"_id": 2, "value": 0}, upsert=True)["n"] == 1
+        c.close()
+
+    def test_document_cas_client(self, mongo_port):
+        t = {"mongodb": {"addr_fn": lambda n: "127.0.0.1",
+                         "ports": {"n1": mongo_port}}}
+        c = mongodb.DocumentCasClient().open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "read", None)).value is None
+        assert c.invoke(t, Op(0, "invoke", "write", 3)).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "cas", (3, 4))).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "cas", (3, 9))).type == "fail"
+        assert c.invoke(t, Op(0, "invoke", "read", None)).value == 4
+
+    def test_transfer_client_conserves_money(self, mongo_port):
+        t = {"mongodb": {"addr_fn": lambda n: "127.0.0.1",
+                         "ports": {"n1": mongo_port}}}
+        c = mongodb.TransferClient(n=3).open(t, "n1")
+        x = c.invoke(t, Op(0, "invoke", "transfer",
+                           {"from": 0, "to": 1, "amount": 4}))
+        assert x.type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read", None))
+        assert sum(r.value.values()) == 30
+        assert r.value[0] == 6 and r.value[1] == 14
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "mongo.tar.gz")
+        mongo_sim.build_archive(archive, str(tmp_path / "s" / "m.json"))
+        t = mongodb.mongodb_rocks_test({
+            "workload": "document-cas",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "mongodb": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 4,
+            "stagger": 0.01,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+        assert t["name"].startswith("mongodb-rocks")
+
+
+@pytest.fixture
+def rethink_port(tmp_path):
+    class H(rethink_sim.Handler):
+        store = rethink_sim.Store(str(tmp_path / "r.json"))
+        mean_latency = 0.0
+
+    srv = rethink_sim.Server(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestRethink:
+    def test_document_cas_client(self, rethink_port):
+        t = {"rethinkdb": {"addr_fn": lambda n: "127.0.0.1",
+                           "ports": {"n1": rethink_port}},
+             "nodes": ["n1"]}
+        c = rethinkdb.DocumentCasClient().open(t, "n1")
+        k = 7
+        r0 = c.invoke(t, Op(0, "invoke", "read",
+                            independent.tuple_(k, None)))
+        assert r0.type == "ok" and r0.value == (k, None)
+        assert c.invoke(t, Op(0, "invoke", "write",
+                              independent.tuple_(k, 2))).type == "ok"
+        good = c.invoke(t, Op(0, "invoke", "cas",
+                              independent.tuple_(k, (2, 3))))
+        assert good.type == "ok"
+        bad = c.invoke(t, Op(0, "invoke", "cas",
+                             independent.tuple_(k, (2, 9))))
+        assert bad.type == "fail"
+        r1 = c.invoke(t, Op(0, "invoke", "read",
+                            independent.tuple_(k, None)))
+        assert r1.value == (k, 3)
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "rethink.tar.gz")
+        rethink_sim.build_archive(archive, str(tmp_path / "s" / "r.json"))
+        t = rethinkdb.rethinkdb_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "rethinkdb": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 5,
+            "ops_per_key": 20,
+            "stagger": 0.01,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+
+
+class TestChronosChecker:
+    def _history(self, jobs, runs, read_time_ns):
+        hist = []
+        i = 0
+        for job in jobs:
+            hist.append(Op(0, "invoke", "add-job", job, index=i, time=i))
+            i += 1
+            hist.append(Op(0, "ok", "add-job", job, index=i, time=i))
+            i += 1
+        hist.append(Op(0, "invoke", "read", None, index=i, time=i))
+        i += 1
+        hist.append(Op(0, "ok", "read", runs, index=i,
+                       time=read_time_ns))
+        return hist
+
+    def test_all_targets_hit(self):
+        job = {"name": 1, "start": 100.0, "count": 3, "duration": 1,
+               "epsilon": 10, "interval": 30}
+        runs = [{"node": "n1", "name": 1, "start": s, "end": s + 1}
+                for s in (101.0, 131.0, 161.0)]
+        hist = self._history([job], runs, int(300e9))
+        res = chronos.ChronosChecker().check({}, hist, {})
+        assert res["valid"] is True, res
+
+    def test_missed_target_detected(self):
+        job = {"name": 1, "start": 100.0, "count": 3, "duration": 1,
+               "epsilon": 10, "interval": 30}
+        runs = [{"node": "n1", "name": 1, "start": 101.0, "end": 102.0}]
+        hist = self._history([job], runs, int(300e9))
+        res = chronos.ChronosChecker().check({}, hist, {})
+        assert res["valid"] is False
+        assert res["jobs"][1]["missed_targets"]
+
+    def test_future_targets_not_required(self):
+        job = {"name": 1, "start": 100.0, "count": 99, "duration": 1,
+               "epsilon": 10, "interval": 30}
+        runs = [{"node": "n1", "name": 1, "start": 101.0, "end": 102.0}]
+        # read at t=120: only the first target is due
+        hist = self._history([job], runs, int(120e9))
+        res = chronos.ChronosChecker().check({}, hist, {})
+        assert res["valid"] is True, res
+
+
+class TestChronosEndToEnd:
+    def test_sim_runs_jobs_and_checker_passes(self, tmp_path):
+        """Schedule real (fast) jobs against the sim, collect run files
+        through the control plane, check the schedule was honored."""
+        nodes = ["n1"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "chronos.tar.gz")
+        chronos_sim.build_archive(archive, str(tmp_path / "s" / "c.json"))
+        jdir = os.path.join(str(tmp_path), "jobruns")
+        os.makedirs(jdir, exist_ok=True)
+        t = chronos.chronos_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "chronos": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+                "job_dir": jdir,
+            },
+            "concurrency": 1,
+            "time_limit": 3,
+            "quiesce": 4,
+            # fast jobs: start ~1s out, tiny durations
+            "chronos_head_start": 1,
+            "chronos_max_duration": 1,
+            "chronos_max_count": 2,
+            "stagger": 1,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        res = result["results"]
+        # every scheduled job must have run on time
+        assert res["chronos"]["valid"] in (True, "unknown"), res
+        reads = [o for o in result["history"]
+                 if o.type == "ok" and o.f == "read"]
+        assert reads and reads[-1].value, "no runs recorded"
