@@ -12,3 +12,32 @@ an in-repo protocol simulator through the same code paths (install
 archive → daemon → wire protocol), so the whole stack is CI-testable
 without network access (SURVEY.md §4.2).
 """
+
+#: suite-module registry: every reference per-DB project and the module
+#: that covers it (txn/charybdefs/docker live outside dbs/ — see
+#: jepsen_tpu.txn, jepsen_tpu.nemesis.fsfault, and docker/)
+SUITES = {
+    "aerospike": "jepsen_tpu.dbs.aerospike",
+    "chronos": "jepsen_tpu.dbs.chronos",
+    "cockroachdb": "jepsen_tpu.dbs.cockroach_workloads",
+    "consul": "jepsen_tpu.dbs.consul",
+    "crate": "jepsen_tpu.dbs.crate",
+    "dgraph": "jepsen_tpu.dbs.dgraph",
+    "disque": "jepsen_tpu.dbs.disque",
+    "elasticsearch": "jepsen_tpu.dbs.elasticsearch",
+    "etcd": "jepsen_tpu.dbs.etcd",
+    "galera": "jepsen_tpu.dbs.galera",
+    "hazelcast": "jepsen_tpu.dbs.hazelcast",
+    "logcabin": "jepsen_tpu.dbs.logcabin",
+    "mongodb-rocks": "jepsen_tpu.dbs.mongodb",
+    "mongodb-smartos": "jepsen_tpu.dbs.mongodb",
+    "mysql-cluster": "jepsen_tpu.dbs.mysql_cluster",
+    "percona": "jepsen_tpu.dbs.percona",
+    "postgres-rds": "jepsen_tpu.dbs.postgres_rds",
+    "rabbitmq": "jepsen_tpu.dbs.rabbitmq",
+    "raftis": "jepsen_tpu.dbs.raftis",
+    "rethinkdb": "jepsen_tpu.dbs.rethinkdb",
+    "robustirc": "jepsen_tpu.dbs.robustirc",
+    "tidb": "jepsen_tpu.dbs.tidb",
+    "zookeeper": "jepsen_tpu.dbs.zookeeper",
+}

@@ -108,23 +108,31 @@ def job_to_json(job: dict, test) -> dict:
 
 def read_runs(test) -> list:
     """Collect every run record from every node's job files
-    (chronos.clj:143-172)."""
+    (chronos.clj:143-172). Files are parsed INDIVIDUALLY — a job still
+    mid-sleep has only [name, start] in its file, and concatenating
+    everything would shift later records out of alignment."""
     remote = test["remote"]
     d = job_dir(test)
+    sep = "==JEPSEN-FILE=="
 
     def read_node(node):
         out = remote.exec(
-            node, f"cat {d}/* 2>/dev/null || true", check=False).out
+            node,
+            f'for f in {d}/*; do echo "{sep}"; cat "$f"; echo; done '
+            "2>/dev/null || true",
+            check=False).out
         runs = []
-        lines = [ln for ln in out.splitlines() if ln.strip()]
-        for i in range(0, len(lines) - 1, 3):
+        for block in out.split(sep):
+            lines = [ln for ln in block.splitlines() if ln.strip()]
+            if len(lines) < 2:
+                continue
             try:
                 runs.append({
                     "node": str(node),
-                    "name": int(lines[i]),
-                    "start": float(lines[i + 1]),
-                    "end": (float(lines[i + 2])
-                            if i + 2 < len(lines) else None),
+                    "name": int(lines[0]),
+                    "start": float(lines[1]),
+                    "end": (float(lines[2])
+                            if len(lines) > 2 else None),
                 })
             except ValueError:
                 continue
@@ -160,7 +168,12 @@ class ChronosClient(client.Client):
                     pass
                 return op.with_(type="ok")
             if op.f == "read":
-                return op.with_(type="ok", value=read_runs(test))
+                # runs carry EPOCH times, so the read moment must be
+                # epoch too (Op.time is relative to test start)
+                return op.with_(type="ok", value={
+                    "time": time.time(),
+                    "runs": read_runs(test),
+                })
             raise ValueError(f"unknown op {op.f!r}")
         except (ConnectionError, socket.timeout, TimeoutError) as e:
             return op.with_(type="fail", error=str(e))
@@ -181,12 +194,10 @@ class ChronosChecker(Checker):
         runs = None
         for o in _ops(history):
             if o.is_ok and o.f == "read":
-                runs = o.value
-                read_time = (o.time or 0) / 1e9 if o.time else None
+                runs = o.value["runs"]
+                read_time = o.value["time"]
         if runs is None:
             return {"valid": "unknown", "error": "no run read"}
-        if read_time is None:
-            read_time = time.time()
 
         runs_by_job: dict = {}
         for run in runs:

@@ -158,7 +158,7 @@ class UpsertClient(client.Client):
                 rows = self.conn.query(
                     f"{{ q(func: eq(key, {k})) {{ uid }} }}")
                 return op.with_(type="ok",
-                                value=[r["uid"] for r in rows])
+                                value=(k, [r["uid"] for r in rows]))
             raise ValueError(f"unknown op {op.f!r}")
         except (DgraphError, socket.timeout, TimeoutError,
                 urllib.error.URLError, OSError) as e:
@@ -170,16 +170,24 @@ class UpsertClient(client.Client):
 
 
 class UpsertChecker(Checker):
-    """At most one upsert per key may succeed, and the final read must
-    show at most one uid (upsert.clj:53-68)."""
+    """At most one upsert per key may succeed, AND the final read must
+    show at most one uid per key (upsert.clj:53-68) — the read catches
+    double-commits whose second ack was lost to a partition (:info)."""
 
     def check(self, test, history, opts=None) -> dict:
         ok_upserts: dict = {}
+        multi_uids: dict = {}
         for o in _ops(history):
             if o.f == "upsert" and o.is_ok:
                 ok_upserts[o.value] = ok_upserts.get(o.value, 0) + 1
+            if o.f == "read" and o.is_ok:
+                k, uids = o.value
+                if len(uids) > 1:
+                    multi_uids[k] = uids
         multi = {k: n for k, n in ok_upserts.items() if n > 1}
-        return {"valid": not multi, "multiple_upserts": multi}
+        return {"valid": not multi and not multi_uids,
+                "multiple_upserts": multi,
+                "multiple_uids": multi_uids}
 
 
 def workloads(opts: dict) -> dict:
@@ -204,6 +212,11 @@ def workloads(opts: dict) -> dict:
                 gen.each(lambda k=k: gen.once(
                     {"type": "invoke", "f": "upsert", "value": k}))
                 for k in range(opts.get("keys", 20))),
+            # final read of every key catches double-commits whose
+            # second ack went :info
+            "final": gen.clients(gen.seq(
+                {"type": "invoke", "f": "read", "value": k}
+                for k in range(opts.get("keys", 20)))),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
                 "upsert": UpsertChecker(),

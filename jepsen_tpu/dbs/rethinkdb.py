@@ -107,8 +107,14 @@ class DocumentCasClient(client.Client):
                 rp.default(rp.get_field(row, "val"), None))
             return op.with_(type="ok", value=independent.tuple_(k, value))
         if op.f == "write":
-            self.conn.run(rp.insert(self._table(), {"id": k, "val": v},
-                                    conflict="update"))
+            res = self.conn.run(
+                rp.insert(self._table(), {"id": k, "val": v},
+                          conflict="update"))
+            # an embedded write error (e.g. lost primary) arrives in a
+            # SUCCESS_ATOM payload, not a RUNTIME_ERROR response
+            if res.get("errors"):
+                return op.with_(type="info",
+                                error=res.get("first_error", "errors"))
             return op.with_(type="ok")
         if op.f == "cas":
             old, new = v

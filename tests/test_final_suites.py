@@ -321,7 +321,8 @@ class TestDgraph:
         r2 = c2.invoke(t, Op(1, "invoke", "upsert", 7))
         assert sorted([r1.type, r2.type]) == ["fail", "ok"]
         read = c1.invoke(t, Op(0, "invoke", "read", 7))
-        assert len(read.value) == 1
+        k, uids = read.value
+        assert k == 7 and len(uids) == 1
 
     def test_upsert_checker(self):
         from jepsen_tpu.dbs import dgraph

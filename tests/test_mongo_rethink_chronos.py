@@ -169,7 +169,7 @@ class TestRethink:
 
 
 class TestChronosChecker:
-    def _history(self, jobs, runs, read_time_ns):
+    def _history(self, jobs, runs, read_time_s):
         hist = []
         i = 0
         for job in jobs:
@@ -179,8 +179,9 @@ class TestChronosChecker:
             i += 1
         hist.append(Op(0, "invoke", "read", None, index=i, time=i))
         i += 1
-        hist.append(Op(0, "ok", "read", runs, index=i,
-                       time=read_time_ns))
+        hist.append(Op(0, "ok", "read",
+                       {"time": read_time_s, "runs": runs},
+                       index=i, time=i))
         return hist
 
     def test_all_targets_hit(self):
@@ -188,7 +189,7 @@ class TestChronosChecker:
                "epsilon": 10, "interval": 30}
         runs = [{"node": "n1", "name": 1, "start": s, "end": s + 1}
                 for s in (101.0, 131.0, 161.0)]
-        hist = self._history([job], runs, int(300e9))
+        hist = self._history([job], runs, 300.0)
         res = chronos.ChronosChecker().check({}, hist, {})
         assert res["valid"] is True, res
 
@@ -196,7 +197,7 @@ class TestChronosChecker:
         job = {"name": 1, "start": 100.0, "count": 3, "duration": 1,
                "epsilon": 10, "interval": 30}
         runs = [{"node": "n1", "name": 1, "start": 101.0, "end": 102.0}]
-        hist = self._history([job], runs, int(300e9))
+        hist = self._history([job], runs, 300.0)
         res = chronos.ChronosChecker().check({}, hist, {})
         assert res["valid"] is False
         assert res["jobs"][1]["missed_targets"]
@@ -206,7 +207,7 @@ class TestChronosChecker:
                "epsilon": 10, "interval": 30}
         runs = [{"node": "n1", "name": 1, "start": 101.0, "end": 102.0}]
         # read at t=120: only the first target is due
-        hist = self._history([job], runs, int(120e9))
+        hist = self._history([job], runs, 120.0)
         res = chronos.ChronosChecker().check({}, hist, {})
         assert res["valid"] is True, res
 
@@ -250,4 +251,4 @@ class TestChronosEndToEnd:
         assert res["chronos"]["valid"] in (True, "unknown"), res
         reads = [o for o in result["history"]
                  if o.type == "ok" and o.f == "read"]
-        assert reads and reads[-1].value, "no runs recorded"
+        assert reads and reads[-1].value["runs"], "no runs recorded"
