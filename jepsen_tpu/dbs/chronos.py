@@ -194,6 +194,11 @@ class ChronosChecker(Checker):
         runs = None
         for o in _ops(history):
             if o.is_ok and o.f == "read":
+                if not isinstance(o.value, dict):
+                    # a pre-dict-format store: no epoch read time was
+                    # recorded, so targets can't be derived honestly
+                    return {"valid": "unknown",
+                            "error": "read lacks epoch timestamp"}
                 runs = o.value["runs"]
                 read_time = o.value["time"]
         if runs is None:

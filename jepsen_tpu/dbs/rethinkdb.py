@@ -126,8 +126,14 @@ class DocumentCasClient(client.Client):
                     rp.error("abort"),
                 )),
             ))
-            ok = res.get("errors") == 0 and res.get("replaced") == 1
-            return op.with_(type="ok" if ok else "fail")
+            if res.get("errors") == 0 and res.get("replaced") == 1:
+                return op.with_(type="ok")
+            first_error = res.get("first_error", "")
+            if res.get("errors") and "abort" not in first_error:
+                # an infrastructure error (e.g. lost primary), not our
+                # deliberate branch abort — the CAS may have applied
+                return op.with_(type="info", error=first_error)
+            return op.with_(type="fail")
         raise ValueError(f"unknown op {op.f!r}")
 
     def close(self, test):

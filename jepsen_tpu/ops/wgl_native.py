@@ -1,0 +1,164 @@
+"""Native (C++) Wing–Gong–Lowe search: the GIL-free host engine for
+models with int32 kernel encodings (native/wgl_search.cpp). Same
+algorithm and verdicts as ops/wgl_host.py; roughly two orders of
+magnitude faster than the pure-Python fallback, which matters exactly
+where the TPU kernel doesn't apply (no accelerator attached, or payload
+shapes the kernel codec rejects are absent but the device is).
+
+The shared library is compiled on first use with the toolchain the
+environment guarantees (g++), cached next to the source keyed by a
+source hash — the same compile-on-demand posture as the on-node clock
+tools (nemesis/time.py)."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+from ..history import Entries, entries as make_entries
+from ..models import Model
+from ..models import jit as mjit
+from .wgl_host import WGLResult
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                           "native")
+_SOURCE = os.path.join(_NATIVE_DIR, "wgl_search.cpp")
+
+_MODEL_KINDS = {
+    "cas-register": 0,
+    "register": 1,
+    "mutex": 2,
+    "unordered-queue": 3,
+}
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeUnavailable(Exception):
+    """No compiler, or the model/history has no native encoding."""
+
+
+def _build_lib():
+    with open(_SOURCE, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    cache_dir = os.path.join(tempfile.gettempdir(), "jepsen-tpu-native")
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"libwglsearch-{digest}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SOURCE],
+                check=True, capture_output=True, text=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise NativeUnavailable(
+                f"can't build native search: {e}") from e
+        os.replace(tmp, so_path)
+    lib = ctypes.CDLL(so_path)
+    lib.wgl_search.restype = ctypes.c_longlong
+    lib.wgl_search.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_int32, ctypes.c_int,
+        ctypes.c_longlong, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong),
+    ]
+    return lib
+
+
+def _get_lib():
+    global _lib
+    with _lock:
+        if _lib is None:
+            _lib = _build_lib()
+        return _lib
+
+
+def eligible(model: Model, es: Entries) -> bool:
+    jm = mjit.for_model(model)
+    return (jm is not None and jm.name in _MODEL_KINDS
+            and jm.lane_eligible(es))
+
+
+def analysis(
+    model: Model,
+    history,
+    time_limit: float | None = None,
+    max_steps: int | None = None,
+) -> WGLResult:
+    """Check linearizability with the native engine. Raises
+    NativeUnavailable when the model/history has no native encoding or
+    no compiler exists — callers fall back to the host search."""
+    es = history if isinstance(history, Entries) else make_entries(history)
+    jm = mjit.for_model(model)
+    if jm is None or jm.name not in _MODEL_KINDS \
+            or not jm.lane_eligible(es):
+        raise NativeUnavailable(f"no native encoding for {model!r}")
+    lib = _get_lib()
+
+    n = len(es)
+    if es.n_completed == 0:
+        return WGLResult(valid=True, final_state=model)
+
+    codec = jm.lane_codec(es)
+    f = np.empty(n, np.int32)
+    v1 = np.empty(n, np.int32)
+    v2 = np.empty(n, np.int32)
+    for e in range(n):
+        f[e], v1[e], v2[e] = jm.encode_entry(es.f[e], es.value_out[e],
+                                             codec)
+    crashed = np.ascontiguousarray(es.crashed, np.uint8)
+    call_pos = np.ascontiguousarray(es.call_pos, np.int64)
+    ret_pos = np.ascontiguousarray(es.ret_pos, np.int64)
+
+    width = jm.lane_width(es)
+    init_state = int(jm.init_vec(max(1, width))[0])
+
+    out_valid = ctypes.c_int(2)
+    out_stuck = ctypes.c_int(-1)
+    out_best = (ctypes.c_int * max(1, n))()
+    out_best_len = ctypes.c_int(0)
+    out_cache = ctypes.c_longlong(0)
+
+    def ptr(arr, ctype):
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    steps = lib.wgl_search(
+        n,
+        ptr(f, ctypes.c_int32), ptr(v1, ctypes.c_int32),
+        ptr(v2, ctypes.c_int32), ptr(crashed, ctypes.c_uint8),
+        ptr(call_pos, ctypes.c_int64), ptr(ret_pos, ctypes.c_int64),
+        _MODEL_KINDS[jm.name], init_state, max(1, width),
+        ctypes.c_longlong(max_steps or 0),
+        ctypes.c_double(time_limit or 0.0),
+        ctypes.byref(out_valid), ctypes.byref(out_stuck),
+        out_best, ctypes.byref(out_best_len), ctypes.byref(out_cache),
+    )
+
+    best = [es.invokes[out_best[i]] for i in range(out_best_len.value)]
+    if out_valid.value == 1:
+        return WGLResult(valid=True, best_linearization=best,
+                         cache_size=out_cache.value, steps=int(steps))
+    if out_valid.value == 0:
+        op = (es.invokes[out_stuck.value]
+              if out_stuck.value >= 0 else None)
+        return WGLResult(valid=False, op=op, best_linearization=best,
+                         cache_size=out_cache.value, steps=int(steps))
+    return WGLResult(valid="unknown", cache_size=out_cache.value,
+                     steps=int(steps))
+
+
+def check(model: Model, history, **kw) -> dict:
+    return analysis(model, history, **kw).to_dict()

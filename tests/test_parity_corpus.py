@@ -135,3 +135,31 @@ class TestTpuParity:
                 )
                 checked += 1
         assert checked >= 25
+
+
+@pytest.mark.parametrize("case", _CASES, ids=_ids(_CASES))
+def test_native_wgl_parity(case):
+    """The C++ engine must reproduce every corpus verdict its models
+    cover (same algorithm and search order as the host oracle)."""
+    from jepsen_tpu.history import entries as make_entries
+    from jepsen_tpu.ops import wgl_native
+
+    try:
+        wgl_native._get_lib()
+    except wgl_native.NativeUnavailable:
+        pytest.skip("no C++ toolchain")
+    model = MODELS[case["model"]]()
+    hist = _fix_values(case["history"])
+    if not wgl_native.eligible(model, make_entries(hist)):
+        pytest.skip("model/history has no native encoding")
+    budget = case["params"].get("budget")
+    if case["expected"] == "unknown":
+        r = wgl_native.analysis(model, hist,
+                                max_steps=budget["max_steps"])
+        assert r.valid == "unknown", case["name"]
+        return
+    r = wgl_native.analysis(model, hist, max_steps=5_000_000)
+    if case["oracle"] == "linear":
+        assert r.valid in (case["expected"], "unknown"), case["name"]
+    else:
+        assert r.valid == case["expected"], case["name"]

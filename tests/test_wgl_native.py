@@ -1,0 +1,138 @@
+"""Native (C++) WGL engine tests: verdict parity with the host oracle
+across models and history shapes, step-count identity (same algorithm,
+same search order), budget semantics, and checker integration."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import models
+from jepsen_tpu.history import Op
+from jepsen_tpu.ops import wgl_host, wgl_native
+from tests.helpers import random_queue_history, random_register_history
+
+try:
+    wgl_native._get_lib()
+    HAVE_NATIVE = True
+except wgl_native.NativeUnavailable:
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_NATIVE,
+                                reason="no C++ toolchain")
+
+
+class TestParity:
+    @pytest.mark.parametrize("corrupt", [0.0, 0.15])
+    @pytest.mark.parametrize("seed", range(8))
+    def test_cas_register_matches_host(self, seed, corrupt):
+        h = random_register_history(n_process=4, n_ops=60,
+                                    corrupt=corrupt, seed=seed)
+        a = wgl_host.analysis(models.CASRegister(), h)
+        b = wgl_native.analysis(models.CASRegister(), h)
+        assert a.valid == b.valid
+        assert a.steps == b.steps  # same algorithm, same search order
+
+    @pytest.mark.parametrize("corrupt", [0.0, 0.3])
+    @pytest.mark.parametrize("seed", range(8))
+    def test_queue_matches_host(self, seed, corrupt):
+        h = random_queue_history(n_process=4, n_ops=50,
+                                 corrupt=corrupt, seed=seed)
+        a = wgl_host.analysis(models.UnorderedQueue(), h)
+        b = wgl_native.analysis(models.UnorderedQueue(), h)
+        assert a.valid == b.valid
+        assert a.steps == b.steps
+
+    def test_register_model(self):
+        h = [
+            Op(0, "invoke", "write", 1, time=0, index=0),
+            Op(0, "ok", "write", 1, time=1, index=1),
+            Op(1, "invoke", "read", None, time=2, index=2),
+            Op(1, "ok", "read", 1, time=3, index=3),
+        ]
+        assert wgl_native.analysis(models.Register(), h).valid is True
+        bad = h[:3] + [Op(1, "ok", "read", 2, time=3, index=3)]
+        r = wgl_native.analysis(models.Register(), bad)
+        assert r.valid is False
+        assert r.op is not None
+
+    def test_mutex_model(self):
+        good = [
+            Op(0, "invoke", "acquire", None, time=0, index=0),
+            Op(0, "ok", "acquire", None, time=1, index=1),
+            Op(0, "invoke", "release", None, time=2, index=2),
+            Op(0, "ok", "release", None, time=3, index=3),
+        ]
+        assert wgl_native.analysis(models.Mutex(), good).valid is True
+        # two non-overlapping acquires with no release: invalid
+        bad = [
+            Op(0, "invoke", "acquire", None, time=0, index=0),
+            Op(0, "ok", "acquire", None, time=1, index=1),
+            Op(1, "invoke", "acquire", None, time=2, index=2),
+            Op(1, "ok", "acquire", None, time=3, index=3),
+        ]
+        assert wgl_native.analysis(models.Mutex(), bad).valid is False
+
+    def test_crash_semantics(self):
+        # a crashed write may (or may not) have happened
+        h = [
+            Op(0, "invoke", "write", 1, time=0, index=0),
+            Op(0, "info", "write", 1, time=1, index=1),
+            Op(1, "invoke", "read", None, time=2, index=2),
+            Op(1, "ok", "read", 1, time=3, index=3),
+        ]
+        assert wgl_native.analysis(models.CASRegister(), h).valid is True
+        h2 = h[:3] + [Op(1, "ok", "read", None, time=3, index=3)]
+        assert wgl_native.analysis(models.CASRegister(), h2).valid is True
+
+    def test_large_bitset(self):
+        # >64 entries exercises the multi-word bitset path
+        h = random_register_history(n_process=5, n_ops=200, seed=3)
+        a = wgl_host.analysis(models.CASRegister(), h)
+        b = wgl_native.analysis(models.CASRegister(), h)
+        assert a.valid == b.valid is True
+        assert a.steps == b.steps
+
+
+class TestBudgets:
+    def test_max_steps_unknown(self):
+        h = random_register_history(n_process=5, n_ops=200, seed=0)
+        r = wgl_native.analysis(models.CASRegister(), h, max_steps=5)
+        assert r.valid == "unknown" and r.steps >= 5
+
+    def test_empty_history_valid(self):
+        assert wgl_native.analysis(models.CASRegister(), []).valid is True
+
+
+class TestEligibility:
+    def test_unencodable_model_raises(self):
+        h = [Op(0, "invoke", "add", 1, time=0, index=0),
+             Op(0, "ok", "add", 1, time=1, index=1)]
+        with pytest.raises(wgl_native.NativeUnavailable):
+            wgl_native.analysis(models.GrowOnlySet(), h)
+
+    def test_eligible_predicate(self):
+        from jepsen_tpu.history import entries
+        h = random_register_history(n_process=2, n_ops=10, seed=0)
+        assert wgl_native.eligible(models.CASRegister(), entries(h))
+        assert not wgl_native.eligible(models.GrowOnlySet(), entries(h))
+
+
+class TestCheckerIntegration:
+    def test_algorithm_native(self):
+        h = random_register_history(n_process=3, n_ops=40, seed=1)
+        res = checker_mod.linearizable(
+            models.CASRegister(), algorithm="native").check({}, h, {})
+        assert res["valid"] is True
+
+    def test_native_invalid_carries_counterexample(self):
+        h = [
+            Op(0, "invoke", "write", 0, time=0, index=0),
+            Op(0, "ok", "write", 0, time=1, index=1),
+            Op(1, "invoke", "read", None, time=2, index=2),
+            Op(1, "ok", "read", 1, time=3, index=3),
+        ]
+        res = checker_mod.linearizable(
+            models.CASRegister(), algorithm="native").check({}, h, {})
+        assert res["valid"] is False
+        assert "op" in res
