@@ -249,6 +249,39 @@ def main():
     log(f"stress-50k: {configs['stress-50k']}")
 
     # ------------------------------------------------------------------
+    # Native C++ engine on the refutation-heavy shape (the non-TPU
+    # fallback's cost center): steps/s vs the pure-Python host search.
+    from jepsen_tpu.ops import wgl_host, wgl_native
+
+    try:
+        wgl_native._get_lib()
+        have_native = True
+    except (wgl_native.NativeUnavailable, OSError) as e:
+        have_native = False
+        log(f"native lane skipped (no toolchain): {e}")
+    if have_native:
+        hist = helpers.random_register_history(
+            n_process=6, n_ops=400, corrupt=0.1, seed=900)
+        t0 = time.monotonic()
+        rh = wgl_host.analysis(CASRegister(), hist, max_steps=2_000_000)
+        t_host = time.monotonic() - t0
+        t0 = time.monotonic()
+        rn = wgl_native.analysis(CASRegister(), hist,
+                                 max_steps=2_000_000)
+        t_native = time.monotonic() - t0
+        # a parity regression must FAIL the bench, not skip the lane
+        assert rh.valid == rn.valid and rh.steps == rn.steps, (
+            rh.valid, rn.valid, rh.steps, rn.steps)
+        configs["native-vs-host"] = {
+            "steps": int(rn.steps),
+            "host_steps_per_s": round(rh.steps / t_host, 1),
+            "native_steps_per_s": round(rn.steps / t_native, 1),
+            "speedup": round((rn.steps / t_native)
+                             / (rh.steps / t_host), 1),
+        }
+        log(f"native-vs-host: {configs['native-vs-host']}")
+
+    # ------------------------------------------------------------------
     # Invalid-heavy: 16 corrupt lanes — the expensive verdict path.
     # Lanes are short (60 events) because refuting linearizability needs
     # an EXHAUSTIVE search of the interleaving space (the reference

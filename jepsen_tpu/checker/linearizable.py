@@ -15,7 +15,12 @@ Backends:
                 otherwise), first definite verdict wins — two distinct
                 algorithms, like knossos.competition racing
                 linear/analysis vs wgl/analysis (checker.clj:125-127).
-  "auto"        tpu when eligible, else host.
+  "native"      ops/wgl_native.py — the C++ engine (same algorithm and
+                search order as host, GIL-free, ~20x steps/sec);
+                compiled on first use, needs a model with an int32
+                encoding.
+  "auto"        tpu when eligible, else native when it builds, else
+                host.
 
 Like the reference, detailed failure artifacts are truncated (the full
 set "can take *hours*" to write, checker.clj:138-141).
@@ -49,6 +54,19 @@ from ..ops import wgl_host
 from . import Checker
 
 TRUNCATE = 10
+
+
+def _native_available(model, es) -> bool:
+    """The C++ engine can take this history AND its library builds."""
+    try:
+        from ..ops import wgl_native
+
+        if not wgl_native.eligible(model, es):
+            return False
+        wgl_native._get_lib()
+        return True
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def _tpu_eligible(model, es) -> bool:
@@ -89,7 +107,12 @@ class Linearizable(Checker):
         es = make_entries(history)
         algorithm = self.algorithm
         if algorithm == "auto":
-            algorithm = "tpu" if _tpu_eligible(model, es) else "host"
+            if _tpu_eligible(model, es):
+                algorithm = "tpu"
+            elif _native_available(model, es):
+                algorithm = "native"
+            else:
+                algorithm = "host"
 
         if algorithm == "host":
             r = wgl_host.analysis(model, es, time_limit=self.time_limit)
@@ -163,18 +186,7 @@ class Linearizable(Checker):
             # prefer the native C++ engine over the pure-Python search
             # when the model has a kernel encoding (same algorithm,
             # GIL-free, ~16x the steps/sec)
-            try:
-                from ..ops import wgl_native
-
-                # the encoding check alone isn't enough: prove the
-                # library actually builds, or WGL silently drops out of
-                # the race on compiler-less machines
-                native_ok = wgl_native.eligible(model, es)
-                if native_ok:
-                    wgl_native._get_lib()
-            except Exception:  # noqa: BLE001
-                native_ok = False
-            if native_ok:
+            if _native_available(model, es):
                 from ..ops import wgl_native
 
                 entrants.append(

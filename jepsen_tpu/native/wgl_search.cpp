@@ -209,7 +209,7 @@ long long wgl_search(int n, const int32_t* f, const int32_t* v1,
   long long steps = 0;
   // computed only when a limit is set: casting a huge sentinel double
   // into the clock's int64 rep would be UB
-  const bool has_deadline = time_limit_s > 0;
+  const bool has_deadline = time_limit_s >= 0;
   const auto deadline =
       std::chrono::steady_clock::now() +
       std::chrono::duration_cast<std::chrono::steady_clock::duration>(
@@ -218,7 +218,7 @@ long long wgl_search(int n, const int32_t* f, const int32_t* v1,
 
   while (true) {
     ++steps;
-    if (max_steps > 0 && steps > max_steps) {
+    if (max_steps >= 0 && steps > max_steps) {
       *out_valid = kUnknown;
       *out_cache_size = static_cast<long long>(cache.size());
       return steps;

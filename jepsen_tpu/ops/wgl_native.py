@@ -102,10 +102,9 @@ def analysis(
     NativeUnavailable when the model/history has no native encoding or
     no compiler exists — callers fall back to the host search."""
     es = history if isinstance(history, Entries) else make_entries(history)
-    jm = mjit.for_model(model)
-    if jm is None or jm.name not in _MODEL_KINDS \
-            or not jm.lane_eligible(es):
+    if not eligible(model, es):
         raise NativeUnavailable(f"no native encoding for {model!r}")
+    jm = mjit.for_model(model)
     lib = _get_lib()
 
     n = len(es)
@@ -141,8 +140,10 @@ def analysis(
         ptr(v2, ctypes.c_int32), ptr(crashed, ctypes.c_uint8),
         ptr(call_pos, ctypes.c_int64), ptr(ret_pos, ctypes.c_int64),
         _MODEL_KINDS[jm.name], init_state, max(1, width),
-        ctypes.c_longlong(max_steps or 0),
-        ctypes.c_double(time_limit or 0.0),
+        # None disables a budget (sentinel -1); an explicit 0 is a
+        # real zero budget — wgl_host parity (immediate "unknown")
+        ctypes.c_longlong(-1 if max_steps is None else max_steps),
+        ctypes.c_double(-1.0 if time_limit is None else time_limit),
         ctypes.byref(out_valid), ctypes.byref(out_stuck),
         out_best, ctypes.byref(out_best_len), ctypes.byref(out_cache),
     )
