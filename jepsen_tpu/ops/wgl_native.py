@@ -86,10 +86,17 @@ def _get_lib():
         return _lib
 
 
-def eligible(model: Model, es: Entries) -> bool:
+def _resolve(model: Model, es: Entries):
+    """The JitModel for (model, es), or None — one eligibility scan."""
     jm = mjit.for_model(model)
-    return (jm is not None and jm.name in _MODEL_KINDS
-            and jm.lane_eligible(es))
+    if jm is None or jm.name not in _MODEL_KINDS \
+            or not jm.lane_eligible(es):
+        return None
+    return jm
+
+
+def eligible(model: Model, es: Entries) -> bool:
+    return _resolve(model, es) is not None
 
 
 def analysis(
@@ -102,9 +109,9 @@ def analysis(
     NativeUnavailable when the model/history has no native encoding or
     no compiler exists — callers fall back to the host search."""
     es = history if isinstance(history, Entries) else make_entries(history)
-    if not eligible(model, es):
+    jm = _resolve(model, es)  # one scan: eligibility + model resolution
+    if jm is None:
         raise NativeUnavailable(f"no native encoding for {model!r}")
-    jm = mjit.for_model(model)
     lib = _get_lib()
 
     n = len(es)
@@ -140,10 +147,12 @@ def analysis(
         ptr(v2, ctypes.c_int32), ptr(crashed, ctypes.c_uint8),
         ptr(call_pos, ctypes.c_int64), ptr(ret_pos, ctypes.c_int64),
         _MODEL_KINDS[jm.name], init_state, max(1, width),
-        # None disables a budget (sentinel -1); an explicit 0 is a
-        # real zero budget — wgl_host parity (immediate "unknown")
-        ctypes.c_longlong(-1 if max_steps is None else max_steps),
-        ctypes.c_double(-1.0 if time_limit is None else time_limit),
+        # None disables a budget (sentinel -1); explicit values are
+        # clamped at 0 so an overshot (negative) budget means "already
+        # expired" exactly like wgl_host, never "unbounded"
+        ctypes.c_longlong(-1 if max_steps is None else max(0, max_steps)),
+        ctypes.c_double(-1.0 if time_limit is None
+                        else max(0.0, time_limit)),
         ctypes.byref(out_valid), ctypes.byref(out_stuck),
         out_best, ctypes.byref(out_best_len), ctypes.byref(out_cache),
     )
