@@ -52,17 +52,34 @@ def ctl_path(opt_dir: str = OPT_DIR) -> str:
 def compile_lib(remote: Remote, node, opt_dir: str = OPT_DIR) -> str:
     """Upload faultfs.cpp and build the shared library on the node
     (the charybdefs analog builds its FUSE binary on-node too,
-    charybdefs.clj:40-65)."""
+    charybdefs.clj:40-65). Idempotent and atomic: an unchanged source
+    skips the rebuild, and a rebuild lands via mv — rewriting a .so IN
+    PLACE while a wrapped daemon has it mmapped can SIGBUS the
+    daemon."""
+    import hashlib
+
     src = os.path.join(_NATIVE_DIR, "faultfs.cpp")
+    with open(src, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    stamp = f"{opt_dir}/faultfs.src.{digest}"
     remote.exec(node, ["mkdir", "-p", opt_dir], sudo=True)
     remote.exec(node, ["chmod", "a+rwx", opt_dir], sudo=True)
+    already = remote.exec(
+        node, f"test -e {stamp} && test -e {lib_path(opt_dir)}",
+        check=False)
+    if getattr(already, "exit", 1) == 0:
+        return lib_path(opt_dir)
+    remote.exec(node, ["mkdir", "-p", opt_dir], sudo=True)
     remote.upload(node, src, f"{opt_dir}/faultfs.cpp")
     remote.exec(
         node,
-        ["g++", "-shared", "-fPIC", "-O2", "-o", LIB_NAME, "faultfs.cpp",
-         "-ldl"],
+        ["g++", "-shared", "-fPIC", "-O2", "-o", f"{LIB_NAME}.tmp",
+         "faultfs.cpp", "-ldl"],
         cd=opt_dir, sudo=True,
     )
+    remote.exec(node, ["mv", "-f", f"{opt_dir}/{LIB_NAME}.tmp",
+                       lib_path(opt_dir)], sudo=True)
+    remote.exec(node, ["touch", stamp], sudo=True)
     return lib_path(opt_dir)
 
 

@@ -1,0 +1,167 @@
+"""Cockroach named-nemesis wrapper tests: Slowing (net degradation
+around the inner nemesis), Restarting (node revival after :stop),
+BumpTime/StrobeTime clock skews, and the skew registry entries —
+driven over DummyRemote command streams (reference behavior:
+cockroachdb/src/jepsen/cockroach/nemesis.clj:152-268)."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import nemesis as nem_mod
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.dbs import cockroach as cr
+from jepsen_tpu.history import Op
+
+
+class _Recorder(nem_mod.Nemesis):
+    """Inner nemesis that records the ops it saw."""
+
+    def __init__(self):
+        self.ops = []
+        self.setup_called = False
+        self.teardown_called = False
+
+    def setup(self, test):
+        self.setup_called = True
+        return self
+
+    def invoke(self, test, op):
+        self.ops.append(op.f)
+        return op.with_(type="info", value="inner")
+
+    def teardown(self, test):
+        self.teardown_called = True
+
+
+class _RecordingNet:
+    def __init__(self):
+        self.calls = []
+
+    def slow(self, test):
+        self.calls.append("slow")
+
+    def fast(self, test):
+        self.calls.append("fast")
+
+
+def _test_map(remote=None, nodes=("n1", "n2")):
+    return {"remote": remote or DummyRemote(), "nodes": list(nodes),
+            "cockroach": {"sudo": None}}
+
+
+def _inv(f, value=None):
+    return Op(process="nemesis", type="invoke", f=f, value=value)
+
+
+class TestSlowing:
+    def test_start_slows_then_invokes_inner(self):
+        inner = _Recorder()
+        net = _RecordingNet()
+        test = _test_map()
+        test["net"] = net
+        slowing = cr.Slowing(inner, dt=0.5)
+        slowing.setup(test)
+        assert inner.setup_called and net.calls == ["fast"]
+        slowing.invoke(test, _inv("start"))
+        assert net.calls == ["fast", "slow"] and inner.ops == ["start"]
+
+    def test_stop_restores_speed_even_if_inner_raises(self):
+        class Exploder(_Recorder):
+            def invoke(self, test, op):
+                raise RuntimeError("boom")
+
+        net = _RecordingNet()
+        test = _test_map()
+        test["net"] = net
+        slowing = cr.Slowing(Exploder(), dt=0.5)
+        with pytest.raises(RuntimeError):
+            slowing.invoke(test, _inv("stop"))
+        assert "fast" in net.calls  # restored despite the inner failure
+
+    def test_teardown_restores_speed(self):
+        inner = _Recorder()
+        net = _RecordingNet()
+        test = _test_map()
+        test["net"] = net
+        cr.Slowing(inner, dt=0.5).teardown(test)
+        assert net.calls == ["fast"] and inner.teardown_called
+
+
+class TestRestarting:
+    def test_stop_restarts_every_node(self):
+        inner = _Recorder()
+        remote = DummyRemote()
+        test = _test_map(remote)
+        restarting = cr.Restarting(inner)
+        restarting.setup(test)
+        out = restarting.invoke(test, _inv("stop"))
+        # inner saw the stop, then cockroach restarted on both nodes
+        assert inner.ops == ["stop"]
+        statuses = out.value[1]
+        assert statuses == ["started", "started"]
+        # each node's restart issues its daemon start (plus a banner
+        # echo); both nodes must appear
+        started_nodes = {n for n, c in remote.commands
+                         if "cockroach" in c and "start" in c}
+        assert started_nodes == {"n1", "n2"}
+
+    def test_start_passes_through(self):
+        inner = _Recorder()
+        remote = DummyRemote()
+        restarting = cr.Restarting(inner)
+        out = restarting.invoke(_test_map(remote), _inv("start"))
+        assert inner.ops == ["start"] and out.value == "inner"
+        assert not [c for _, c in remote.commands if "start-stop-daemon"
+                    in c or "cockroach" in c]
+
+
+class TestClockNemeses:
+    def test_bump_time_start_bumps_half_and_stop_resets(self, monkeypatch):
+        remote = DummyRemote()
+        test = _test_map(remote)
+        bump = cr.BumpTime(0.25)
+        # deterministic coin: every node gets bumped
+        import random as _random
+
+        monkeypatch.setattr(_random, "random", lambda: 0.0)
+        monkeypatch.setattr(cr.nt, "install", lambda r, n: None)
+        # DummyRemote returns empty output; the bump tool's offset
+        # parse is not what's under test here
+        monkeypatch.setattr(cr.nt, "parse_time", lambda s: 0.0)
+        out = bump.invoke(test, _inv("start"))
+        assert out.value == {"n1": 0.25, "n2": 0.25}
+        bumps = [c for _, c in remote.commands if "bump-time" in c]
+        assert len(bumps) == 2 and "250" in bumps[0]
+        out = bump.invoke(test, _inv("stop"))
+        assert out.value == "clocks-reset"
+        resets = [c for _, c in remote.commands if "ntpdate" in c
+                  or "reset" in c or "date" in c]
+        assert resets
+
+    def test_strobe_time_start_strobes_all(self, monkeypatch):
+        remote = DummyRemote()
+        test = _test_map(remote)
+        monkeypatch.setattr(cr.nt, "install", lambda r, n: None)
+        strobe = cr.StrobeTime(200, 10, 5)
+        out = strobe.invoke(test, _inv("start"))
+        assert out.value == "strobed"
+        strobes = [c for _, c in remote.commands if "strobe-time" in c]
+        assert len(strobes) == 2
+
+
+class TestSkewRegistry:
+    def test_skew_entries_compose_wrappers(self):
+        small = cr.small_skews()
+        assert small["clocks"] is True
+        assert isinstance(small["client"], cr.Restarting)
+        big = cr.big_skews()
+        # big skews wrap the restarting bump in network slowing
+        assert isinstance(big["client"], cr.Slowing)
+        assert isinstance(big["client"].nem, cr.Restarting)
+
+    def test_strobe_skews_has_no_sleeps(self):
+        entry = cr.strobe_skews()
+        assert entry["clocks"] is True
+        assert isinstance(entry["client"], cr.Restarting)
+        assert isinstance(entry["client"].nem, cr.StrobeTime)
