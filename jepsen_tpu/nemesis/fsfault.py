@@ -64,12 +64,9 @@ def compile_lib(remote: Remote, node, opt_dir: str = OPT_DIR) -> str:
     stamp = f"{opt_dir}/faultfs.src.{digest}"
     remote.exec(node, ["mkdir", "-p", opt_dir], sudo=True)
     remote.exec(node, ["chmod", "a+rwx", opt_dir], sudo=True)
-    already = remote.exec(
-        node, f"test -e {stamp} && test -e {lib_path(opt_dir)}",
-        check=False)
-    if getattr(already, "exit", 1) == 0:
+    if exists(remote, node, stamp) and exists(remote, node,
+                                              lib_path(opt_dir)):
         return lib_path(opt_dir)
-    remote.exec(node, ["mkdir", "-p", opt_dir], sudo=True)
     remote.upload(node, src, f"{opt_dir}/faultfs.cpp")
     remote.exec(
         node,
@@ -79,6 +76,9 @@ def compile_lib(remote: Remote, node, opt_dir: str = OPT_DIR) -> str:
     )
     remote.exec(node, ["mv", "-f", f"{opt_dir}/{LIB_NAME}.tmp",
                        lib_path(opt_dir)], sudo=True)
+    # one stamp at a time: a stale stamp from an OLDER source version
+    # would let a revert skip the rebuild and run mismatched code
+    remote.exec(node, f"rm -f {opt_dir}/faultfs.src.*", check=False)
     remote.exec(node, ["touch", stamp], sudo=True)
     return lib_path(opt_dir)
 

@@ -1,0 +1,180 @@
+"""Nemesis-layer tests: pure grudge math (reference:
+nemesis_test.clj:18-60's invariants), the partitioner's iptables
+command stream over DummyRemote, compose routing, and the
+kill/pause/truncate nemeses."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import net
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.history import Op
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class TestGrudgeMath:
+    def test_bisect_splits_evenly(self):
+        a, b = nem.bisect(NODES)
+        assert len(a) == 2 and len(b) == 3
+        assert sorted(a + b) == NODES
+
+    def test_split_one_isolates_one(self):
+        lonely, rest = nem.split_one(NODES, node="n3")
+        assert lonely == ["n3"] and sorted(rest) == ["n1", "n2", "n4", "n5"]
+
+    def test_complete_grudge_symmetric_and_total(self):
+        a, b = nem.bisect(NODES)
+        grudge = nem.complete_grudge([a, b])
+        # every node appears; components hate exactly the other side
+        assert sorted(grudge) == NODES
+        for n in a:
+            assert grudge[n] == set(b)
+        for n in b:
+            assert grudge[n] == set(a)
+        # symmetry: m in grudge[n] <=> n in grudge[m]
+        for n, banned in grudge.items():
+            for m in banned:
+                assert n in grudge[m]
+
+    def test_bridge_node_sees_everyone(self):
+        grudge = nem.bridge(NODES)
+        bridge_node = [n for n in NODES if not grudge.get(n)]
+        assert len(bridge_node) == 1
+        others = [n for n in NODES if n != bridge_node[0]]
+        # the two halves can't see each other but all see the bridge
+        for n in others:
+            assert bridge_node[0] not in grudge[n]
+            assert grudge[n]
+
+    def test_majorities_ring_every_node_sees_majority(self):
+        grudge = nem.majorities_ring(NODES)
+        n_nodes = len(NODES)
+        for n, banned in grudge.items():
+            visible = n_nodes - len(banned)  # incl. itself
+            assert visible > n_nodes // 2, (n, banned)
+        # and no two nodes see the same component (the ring property:
+        # grudges differ)
+        assert len({frozenset(b) for b in grudge.values()}) > 1
+
+
+class TestPartitioner:
+    def _test_map(self, remote):
+        return {"remote": remote, "nodes": list(NODES),
+                "net": net.iptables}
+
+    def test_start_drops_and_stop_heals(self, monkeypatch):
+        from jepsen_tpu.control import net as cnet
+
+        monkeypatch.setattr(cnet, "ip",
+                            lambda test, node: f"10.0.0.{node[-1]}")
+        remote = DummyRemote()
+        test = self._test_map(remote)
+        part = nem.partition_random_halves()
+        part.setup(test)
+        out = part.invoke(test, Op("nemesis", "invoke", "start", None))
+        assert out.type == "info"
+        drops = [c for _, c in remote.commands
+                 if "iptables" in c and "DROP" in c]
+        assert drops, "no drop rules issued"
+        n_flushes_before = len([c for _, c in remote.commands
+                                if "iptables -F" in c])
+        out = part.invoke(test, Op("nemesis", "invoke", "stop", None))
+        flushes = [c for _, c in remote.commands if "iptables -F" in c]
+        # stop heals every node (setup healed once already)
+        assert len(flushes) - n_flushes_before == len(NODES)
+
+    def test_partition_halves_value_names_components(self, monkeypatch):
+        from jepsen_tpu.control import net as cnet
+
+        monkeypatch.setattr(cnet, "ip",
+                            lambda test, node: f"10.0.0.{node[-1]}")
+        remote = DummyRemote()
+        test = self._test_map(remote)
+        part = nem.partition_halves()
+        part.setup(test)
+        out = part.invoke(test, Op("nemesis", "invoke", "start", None))
+        assert out.value is not None
+
+
+class TestComposeRouting:
+    def test_routes_by_f_set_and_restores_outer_f(self):
+        class Recording(nem.Nemesis):
+            def __init__(self):
+                self.fs = []
+
+            def invoke(self, test, op):
+                self.fs.append(op.f)
+                return op.with_(type="info")
+
+        a, b = Recording(), Recording()
+        comp = nem.compose({
+            frozenset({"start-a", "stop-a"}): a,
+            frozenset({"start-b"}): b,
+        })
+        out = comp.invoke({}, Op("nemesis", "invoke", "start-a", None))
+        assert a.fs == ["start-a"] and out.f == "start-a"
+        comp.invoke({}, Op("nemesis", "invoke", "start-b", None))
+        assert b.fs == ["start-b"]
+        with pytest.raises(ValueError):
+            comp.invoke({}, Op("nemesis", "invoke", "nope", None))
+
+    def test_fmap_routing_renames_inner_f(self):
+        class Recording(nem.Nemesis):
+            def __init__(self):
+                self.fs = []
+
+            def invoke(self, test, op):
+                self.fs.append(op.f)
+                return op.with_(type="info")
+
+        inner = Recording()
+        comp = nem.compose({
+            type("FMap", (dict,), {"__hash__": object.__hash__})(
+                {"outer-start": "start"}): inner,
+        })
+        out = comp.invoke({}, Op("nemesis", "invoke", "outer-start", None))
+        assert inner.fs == ["start"]
+        assert out.f == "outer-start"  # outer name restored
+
+
+class TestProcessNemeses:
+    def test_hammer_time_pauses_and_resumes(self):
+        remote = DummyRemote()
+        test = {"remote": remote, "nodes": list(NODES)}
+        hammer = nem.hammer_time("mydb",
+                                 targeter=lambda nodes: [nodes[0]])
+        out = hammer.invoke(test, Op("nemesis", "invoke", "start", None))
+        assert out.value == {"n1": "paused"}
+        stops = [c for _, c in remote.commands if "STOP" in c]
+        assert stops and "mydb" in stops[0]
+        out = hammer.invoke(test, Op("nemesis", "invoke", "stop", None))
+        assert out.value == {"n1": "resumed"}
+        assert any("CONT" in c for _, c in remote.commands)
+
+    def test_start_stopper_tracks_affected(self):
+        killed, revived = [], []
+        stopper = nem.node_start_stopper(
+            lambda nodes: nodes[:2],
+            lambda t, n: killed.append(n) or "down",
+            lambda t, n: revived.append(n) or "up",
+        )
+        test = {"remote": DummyRemote(), "nodes": list(NODES)}
+        stopper.invoke(test, Op("nemesis", "invoke", "start", None))
+        assert killed == ["n1", "n2"]
+        # a second start while affected is a no-op
+        out = stopper.invoke(test, Op("nemesis", "invoke", "start", None))
+        assert "already" in str(out.value)
+        stopper.invoke(test, Op("nemesis", "invoke", "stop", None))
+        assert revived == ["n1", "n2"]
+
+    def test_truncate_file_command(self):
+        remote = DummyRemote()
+        test = {"remote": remote, "nodes": list(NODES)}
+        trunc = nem.truncate_file("/var/lib/db/log", drop_bytes=64,
+                                  targeter=lambda nodes: [nodes[0]])
+        trunc.invoke(test, Op("nemesis", "invoke", "truncate", None))
+        cmds = [c for _, c in remote.commands if "truncate" in c]
+        assert cmds and "/var/lib/db/log" in cmds[0] and "64" in cmds[0]
