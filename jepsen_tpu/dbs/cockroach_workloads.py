@@ -14,6 +14,7 @@ import itertools
 import logging
 import random
 import threading
+import zlib
 
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, independent, models
@@ -517,6 +518,276 @@ def g2_workload(opts: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Sequential (sequential.clj): per-key subkeys written in order into
+# hash-distributed tables; reads traverse in REVERSE order, so seeing a
+# later subkey while an earlier one is missing is a sequential-
+# consistency violation.
+
+
+def _stable_hash(x) -> int:
+    return zlib.crc32(str(x).encode())
+
+
+SEQ_TABLE_PREFIX = "seq_"
+
+
+class SequentialClient(client.Client):
+    """sequential.clj:30-90: write inserts k_0..k_{n-1} in order, each
+    into table seq_{hash(subkey) % table_count}; read selects the
+    subkeys in reverse order and reports which were present."""
+
+    def __init__(self, table_count: int = 5, key_count: int = 5,
+                 conn=None, flag=None):
+        self.table_count = table_count
+        self.key_count = key_count
+        self.conn = conn
+        self.flag = flag or _shared_flag()
+
+    def _table(self, subkey) -> str:
+        return (SEQ_TABLE_PREFIX
+                + str(_stable_hash(subkey) % self.table_count))
+
+    def _subkeys(self, k) -> list:
+        return [f"{k}_{i}" for i in range(self.key_count)]
+
+    def open(self, test, node):
+        return SequentialClient(self.table_count, self.key_count,
+                                cr.conn_wrapper(test, node), self.flag)
+
+    def setup(self, test):
+        def create():
+            with self.conn.with_conn() as c:
+                for i in range(self.table_count):
+                    t = f"{SEQ_TABLE_PREFIX}{i}"
+                    cr.txn_retry(
+                        lambda t=t: c.query(f"drop table if exists {t}"))
+                    cr.txn_retry(lambda t=t: c.query(
+                        f"create table {t} (key varchar primary key)"))
+
+        _once(self.flag, create)
+
+    def invoke(self, test, op: Op) -> Op:
+        k = op.value
+
+        def body(c):
+            if op.f == "write":
+                for sub in self._subkeys(k):
+                    cr.txn_retry(lambda sub=sub: c.query(
+                        f"insert into {self._table(sub)} (key) "
+                        f"values ('{sub}')"))
+                return op.with_(type="ok")
+            if op.f == "read":
+                found = []
+                for sub in reversed(self._subkeys(k)):
+                    rows = cr.txn_retry(lambda sub=sub: c.query(
+                        f"select key from {self._table(sub)} "
+                        f"where key = '{sub}'").rows)
+                    found.append(sub if rows else None)
+                return op.with_(type="ok", value=(k, found))
+            raise ValueError(f"unknown op {op.f!r}")
+
+        return cr.invoke_with_taxonomy(self.conn, op, body,
+                                       idempotent_fs={"read"})
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+class SequentialChecker(Checker):
+    """In a read's reverse traversal (latest-written subkey first),
+    once any subkey is seen every LATER-traversed (earlier-written)
+    subkey must be present — a gap means writes became visible out of
+    order (sequential.clj's analysis)."""
+
+    def check(self, test, history, opts=None) -> dict:
+        bad = []
+        for o in _ops(history):
+            if not (o.is_ok and o.f == "read"):
+                continue
+            k, found = o.value
+            seen = False
+            for sub in found:
+                if sub is not None:
+                    seen = True
+                elif seen:
+                    bad.append({"key": k, "read": found,
+                                "op": o.to_dict()})
+                    break
+        return {"valid": not bad, "bad_reads": bad[:10]}
+
+
+def sequential_workload(opts: dict) -> dict:
+    keys = itertools.count()
+    lock = threading.Lock()
+    written: list = []
+
+    def w(test, process):
+        with lock:
+            k = next(keys)
+            written.append(k)
+        return {"type": "invoke", "f": "write", "value": k}
+
+    def r(test, process):
+        with lock:
+            k = random.choice(written) if written else 0
+        return {"type": "invoke", "f": "read", "value": k}
+
+    return {
+        "name": "sequential",
+        "client": SequentialClient(opts.get("tables", 5),
+                                   opts.get("key_count", 5)),
+        "during": gen.stagger(opts.get("stagger", 0.05),
+                              gen.mix([w, r, r])),
+        "checker": checker_mod.compose({
+            "perf": checker_mod.perf_checker(),
+            "sequential": SequentialChecker(),
+        }),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Comments (comments.clj): the stale-comment anomaly — if write w1
+# completed before write w2 began, a read that sees w2's id must see
+# w1's id.
+
+
+COMMENT_TABLE_PREFIX = "comment_"
+
+
+class CommentsClient(client.Client):
+    """comments.clj:36-80: writes insert (id, key) into
+    comment_{hash(id) % table_count}; reads union all tables' ids for
+    the key inside one transaction."""
+
+    def __init__(self, table_count: int = 5, conn=None, flag=None):
+        self.table_count = table_count
+        self.conn = conn
+        self.flag = flag or _shared_flag()
+
+    def _table(self, comment_id) -> str:
+        return (COMMENT_TABLE_PREFIX
+                + str(_stable_hash(comment_id) % self.table_count))
+
+    def open(self, test, node):
+        return CommentsClient(self.table_count,
+                              cr.conn_wrapper(test, node), self.flag)
+
+    def setup(self, test):
+        def create():
+            with self.conn.with_conn() as c:
+                for i in range(self.table_count):
+                    t = f"{COMMENT_TABLE_PREFIX}{i}"
+                    cr.txn_retry(
+                        lambda t=t: c.query(f"drop table if exists {t}"))
+                    cr.txn_retry(lambda t=t: c.query(
+                        f"create table {t} (id int primary key, "
+                        "key int)"))
+
+        _once(self.flag, create)
+
+    def invoke(self, test, op: Op) -> Op:
+        k, comment_id = op.value
+
+        def body(c):
+            if op.f == "write":
+                cr.txn_retry(lambda: c.query(
+                    f"insert into {self._table(comment_id)} (id, key) "
+                    f"values ({comment_id}, {k})"))
+                return op.with_(type="ok")
+            if op.f == "read":
+                def run():
+                    with cr.txn(c):
+                        ids = []
+                        for i in range(self.table_count):
+                            ids += c.query(
+                                f"select id from {COMMENT_TABLE_PREFIX}"
+                                f"{i} where key = {k}").scalars()
+                        return sorted(int(x) for x in ids)
+
+                ids = cr.txn_retry(run)
+                return op.with_(type="ok", value=(k, ids))
+            raise ValueError(f"unknown op {op.f!r}")
+
+        return cr.invoke_with_taxonomy(self.conn, op, body,
+                                       idempotent_fs={"read"})
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+class CommentsChecker(Checker):
+    """For writes w1, w2 on the same key where w1's :ok precedes w2's
+    :invoke in real time, any read that includes w2's id must include
+    w1's id (comments.clj's analysis of the lost-comment anomaly)."""
+
+    def check(self, test, history, opts=None) -> dict:
+        ops = _ops(history)
+        # per-key write windows: id -> (invoke_index, ok_index)
+        invoked: dict = {}
+        windows: dict = {}
+        for i, o in enumerate(ops):
+            if o.f != "write":
+                continue
+            key = o.value
+            if o.is_invoke:
+                invoked[(o.process, key)] = i
+            elif o.is_ok:
+                start = invoked.get((o.process, key))
+                if start is not None:
+                    k, comment_id = key
+                    windows.setdefault(k, []).append(
+                        (comment_id, start, i))
+        bad = []
+        for i, o in enumerate(ops):
+            if not (o.is_ok and o.f == "read"):
+                continue
+            k, ids = o.value
+            seen = set(ids)
+            for id2, inv2, ok2 in windows.get(k, []):
+                if id2 not in seen:
+                    continue
+                for id1, inv1, ok1 in windows.get(k, []):
+                    if id1 == id2 or id1 in seen:
+                        continue
+                    # w1 finished before w2 began, and before this read
+                    if ok1 < inv2 and ok1 < i:
+                        bad.append({"key": k, "saw": id2,
+                                    "missing": id1,
+                                    "op": o.to_dict()})
+                        break
+        return {"valid": not bad, "anomalies": bad[:10]}
+
+
+def comments_workload(opts: dict) -> dict:
+    ids = itertools.count()
+    lock = threading.Lock()
+    n_keys = opts.get("keys", 3)
+
+    def w(test, process):
+        with lock:
+            comment_id = next(ids)
+        return {"type": "invoke", "f": "write",
+                "value": (random.randrange(n_keys), comment_id)}
+
+    def r(test, process):
+        return {"type": "invoke", "f": "read",
+                "value": (random.randrange(n_keys), None)}
+
+    return {
+        "name": "comments",
+        "client": CommentsClient(opts.get("tables", 5)),
+        "during": gen.stagger(opts.get("stagger", 0.05),
+                              gen.mix([w, r])),
+        "checker": checker_mod.compose({
+            "perf": checker_mod.perf_checker(),
+            "comments": CommentsChecker(),
+        }),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Runner (runner.clj)
 
 
@@ -526,6 +797,8 @@ def workloads() -> dict:
         "bank": bank_workload,
         "sets": sets_workload,
         "monotonic": monotonic_workload,
+        "sequential": sequential_workload,
+        "comments": comments_workload,
         "g2": g2_workload,
     }
 

@@ -352,7 +352,8 @@ class TestMonotonicChecker:
 class TestCli:
     def test_workload_registry(self):
         assert set(crw.workloads()) == {
-            "register", "bank", "sets", "monotonic", "g2"}
+            "register", "bank", "sets", "monotonic", "sequential",
+            "comments", "g2"}
 
     def test_cli_requires_workload(self):
         from jepsen_tpu import cli as cli_mod
@@ -372,3 +373,70 @@ class TestCli:
         assert t["name"] == "cockroachdb bank parts"
         assert isinstance(t["client"], crw.BankClient)
         assert t["accounts"] == [0, 1, 2, 3, 4]
+
+
+class TestSequentialChecker:
+    def _read(self, k, found, index=0):
+        return [Op(0, "invoke", "read", k, index=index, time=index),
+                Op(0, "ok", "read", (k, found), index=index + 1,
+                   time=index + 1)]
+
+    def test_full_and_prefixless_reads_valid(self):
+        # nothing seen, or a clean suffix in reverse order, is fine
+        ok1 = self._read(1, [None, None, None])
+        ok2 = self._read(1, [None, "1_1", "1_0"])
+        ok3 = self._read(1, ["1_2", "1_1", "1_0"])
+        for hist in (ok1, ok2, ok3):
+            assert crw.SequentialChecker().check({}, hist, {})[
+                "valid"] is True
+
+    def test_gap_detected(self):
+        # saw the LATEST subkey but an earlier one is missing
+        bad = self._read(1, ["1_2", None, "1_0"])
+        res = crw.SequentialChecker().check({}, bad, {})
+        assert res["valid"] is False and res["bad_reads"]
+
+
+class TestCommentsChecker:
+    def _hist(self, read_sees):
+        # w(id=1) completes BEFORE w(id=2) begins; then a read
+        return [
+            Op(0, "invoke", "write", (7, 1), index=0, time=0),
+            Op(0, "ok", "write", (7, 1), index=1, time=1),
+            Op(1, "invoke", "write", (7, 2), index=2, time=2),
+            Op(1, "ok", "write", (7, 2), index=3, time=3),
+            Op(2, "invoke", "read", (7, None), index=4, time=4),
+            Op(2, "ok", "read", (7, read_sees), index=5, time=5),
+        ]
+
+    def test_complete_read_valid(self):
+        res = crw.CommentsChecker().check({}, self._hist([1, 2]), {})
+        assert res["valid"] is True
+
+    def test_stale_comment_detected(self):
+        # sees the LATER write but not the earlier one
+        res = crw.CommentsChecker().check({}, self._hist([2]), {})
+        assert res["valid"] is False
+        assert res["anomalies"][0]["missing"] == 1
+
+    def test_seeing_neither_is_fine(self):
+        res = crw.CommentsChecker().check({}, self._hist([]), {})
+        assert res["valid"] is True
+
+
+class TestNewWorkloadRuns:
+    def test_sequential_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "sequential", time_limit=5,
+                         key_count=3, tables=3)
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        reads = [o for o in result["history"]
+                 if o.type == "ok" and o.f == "read"]
+        assert reads
+
+    def test_comments_workload(self, tmp_path):
+        t = _engine_test(tmp_path, "comments", time_limit=5, keys=2)
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
