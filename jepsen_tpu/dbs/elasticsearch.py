@@ -196,6 +196,117 @@ class SetClient(client.Client):
             return op.with_(type=crash, error=str(e))
 
 
+class DirtyReadClient(client.Client):
+    """dirty_read.clj:32-104: writers index docs by id; readers GET
+    in-flight ids (:ok when found); the final phase refreshes and does
+    one strong read (search-all) per client. A read that shows a value
+    absent from EVERY strong read observed a write that never
+    committed."""
+
+    def __init__(self, conn: EsConn | None = None, timeout: float = 5.0):
+        self.conn = conn
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return DirtyReadClient(
+            EsConn(node_host(test, node), node_port(test, node),
+                   timeout=self.timeout), timeout=self.timeout)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                self.conn.index_doc(str(op.value), {"id": op.value})
+                return op.with_(type="ok")
+            if op.f == "read":
+                source, _ = self.conn.get_doc(str(op.value))
+                return op.with_(type="ok" if source else "fail")
+            if op.f == "refresh":
+                self.conn.refresh()
+                return op.with_(type="ok")
+            if op.f == "strong-read":
+                ids = sorted(d["id"] for d in self.conn.search_all()
+                             if "id" in d)
+                return op.with_(type="ok", value=ids)
+            raise ValueError(f"unknown op {op.f!r}")
+        except (socket.timeout, TimeoutError):
+            # reads/refresh/strong-read have no side effects: definite
+            # :fail (the module-wide read convention); only writes are
+            # indeterminate
+            crash = "info" if op.f == "write" else "fail"
+            return op.with_(type=crash, error="timeout")
+        except (urllib.error.URLError, OSError) as e:
+            crash = "info" if op.f == "write" else "fail"
+            return op.with_(type=crash, error=str(e))
+
+
+class DirtyReadChecker(checker_mod.Checker):
+    """dirty_read.clj:106-156: dirty = ok reads absent from every
+    strong read (saw an uncommitted write); lost = ok writes absent
+    from every strong read; nodes agree when all strong reads match."""
+
+    def check(self, test, history, opts=None) -> dict:
+        from ..history import ops as _ops
+
+        writes, reads, strong = set(), set(), []
+        strong_attempted = 0
+        for o in _ops(history):
+            if o.f == "strong-read" and o.is_invoke:
+                strong_attempted += 1
+            if not o.is_ok:
+                continue
+            if o.f == "write":
+                writes.add(o.value)
+            elif o.f == "read":
+                reads.add(o.value)
+            elif o.f == "strong-read":
+                strong.append(set(o.value))
+        if not strong or len(strong) < strong_attempted:
+            # a node whose strong read never completed is exactly the
+            # suspect node — partial coverage can't prove anything
+            return {"valid": "unknown",
+                    "error": f"only {len(strong)}/{strong_attempted} "
+                             "strong reads completed"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        dirty = reads - on_some
+        lost = writes - on_some
+        return {
+            "valid": not dirty and not lost and on_all == on_some,
+            "nodes_agree": on_all == on_some,
+            "read_count": len(reads),
+            "on_all_count": len(on_all),
+            "on_some_count": len(on_some),
+            "not_on_all": sorted(on_some - on_all)[:10],
+            "dirty": sorted(dirty)[:10],
+            "lost": sorted(lost)[:10],
+            "some_lost": sorted(writes - on_all)[:10],
+        }
+
+
+def dirty_rw_gen():
+    """Writers emit sequential ids; readers probe recently in-flight
+    ids (dirty_read.clj:160-189)."""
+    import collections
+    import threading
+
+    counter = itertools.count()
+    recent: collections.deque = collections.deque(maxlen=32)
+    lock = threading.Lock()
+
+    def w(test, process):
+        v = next(counter)
+        with lock:
+            recent.append(v)
+        return {"type": "invoke", "f": "write", "value": v}
+
+    def rd(test, process):
+        with lock:
+            v = random.choice(list(recent)) if recent else 0
+        return {"type": "invoke", "f": "read", "value": v}
+
+    return gen.mix([w, rd, rd])
+
+
 def r(test, process):
     return {"type": "invoke", "f": "read", "value": None}
 
@@ -218,6 +329,20 @@ def workloads() -> dict:
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
                 "linear": checker_mod.linearizable(),
+            }),
+        },
+        "dirty-read": {
+            "client": DirtyReadClient(),
+            "during": gen.stagger(0.02, dirty_rw_gen()),
+            # es_test wraps finals in gen.clients (set-workload
+            # convention)
+            "final": gen.each(lambda: gen.seq([
+                gen.once({"type": "invoke", "f": "refresh"}),
+                gen.once({"type": "invoke", "f": "strong-read"}),
+            ])),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "dirty-read": DirtyReadChecker(),
             }),
         },
         "set": {

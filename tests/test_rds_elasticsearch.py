@@ -173,18 +173,22 @@ class TestEsClients:
         assert c.invoke(t, Op(0, "invoke", "write", 1)).type == "info"
 
 
+def _es_cluster(tmp_path, nodes):
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "es-sim.tar.gz")
+    es_sim.build_archive(archive, str(tmp_path / "s" / "es.json"))
+    cfg = {
+        "addr_fn": lambda n: "127.0.0.1",
+        "ports": {n: free_port() for n in nodes},
+        "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+        "sudo": None,
+    }
+    return remote, archive, cfg
+
+
 class TestEsFullRuns:
     def _cluster(self, tmp_path, nodes):
-        remote = LocalRemote(root=str(tmp_path / "nodes"))
-        archive = str(tmp_path / "es-sim.tar.gz")
-        es_sim.build_archive(archive, str(tmp_path / "s" / "es.json"))
-        cfg = {
-            "addr_fn": lambda n: "127.0.0.1",
-            "ports": {n: free_port() for n in nodes},
-            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
-            "sudo": None,
-        }
-        return remote, archive, cfg
+        return _es_cluster(tmp_path, nodes)
 
     def test_register_workload(self, tmp_path):
         nodes = ["n1", "n2"]
@@ -230,3 +234,73 @@ class TestEsFullRuns:
         )
         result = core.run(t)
         assert result["results"]["valid"] is True, result["results"]
+
+
+class TestEsDirtyRead:
+    def test_dirty_read_checker(self):
+        def sr(ids, p, i):
+            return [Op(p, "invoke", "strong-read", None, index=i, time=i),
+                    Op(p, "ok", "strong-read", ids, index=i + 1,
+                       time=i + 1)]
+
+        base = [
+            Op(0, "invoke", "write", 1, index=0, time=0),
+            Op(0, "ok", "write", 1, index=1, time=1),
+            Op(1, "invoke", "read", 1, index=2, time=2),
+            Op(1, "ok", "read", 1, index=3, time=3),
+        ]
+        ok = base + sr([1], 0, 10) + sr([1], 1, 20)
+        res = es.DirtyReadChecker().check({}, ok, {})
+        assert res["valid"] is True, res
+        # dirty: read value 2 never shows in any strong read
+        dirty = base + [
+            Op(2, "invoke", "read", 2, index=4, time=4),
+            Op(2, "ok", "read", 2, index=5, time=5),
+        ] + sr([1], 0, 10) + sr([1], 1, 20)
+        res = es.DirtyReadChecker().check({}, dirty, {})
+        assert res["valid"] is False and res["dirty"] == [2]
+        # lost: acked write missing everywhere
+        lost = base + sr([], 0, 10) + sr([], 1, 20)
+        res = es.DirtyReadChecker().check({}, lost, {})
+        assert res["valid"] is False and res["lost"] == [1]
+        # disagree: strong reads differ
+        disagree = base + sr([1], 0, 10) + sr([], 1, 20)
+        res = es.DirtyReadChecker().check({}, disagree, {})
+        assert res["valid"] is False and not res["nodes_agree"]
+
+    def test_dirty_read_client(self, es_port):
+        t = _es_test_map(es_port)
+        c = es.DirtyReadClient().open(t, "n1")
+        assert c.invoke(t, Op(0, "invoke", "write", 7)).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "read", 7)).type == "ok"
+        assert c.invoke(t, Op(0, "invoke", "read", 99)).type == "fail"
+        assert c.invoke(t, Op(0, "invoke", "refresh", None)).type == "ok"
+        sr = c.invoke(t, Op(0, "invoke", "strong-read", None))
+        assert sr.type == "ok" and sr.value == [7]
+
+    def test_full_run(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote, archive, cfg = _es_cluster(tmp_path, nodes)
+        t = es.es_test({
+            "workload": "dirty-read",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "elasticsearch": cfg,
+            "concurrency": 4,
+            "time_limit": 8,
+            "quiesce": 0.2,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        wl = es.workloads()["dirty-read"]
+        t["client"] = wl["client"]
+        t["generator"] = gen.phases(
+            gen.time_limit(4, gen.clients(gen.stagger(
+                0.01, es.dirty_rw_gen()))),
+            gen.clients(wl["final"]),
+        )
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
