@@ -74,18 +74,24 @@ class ArchiveDB(db.DB, db.LogFiles):
     def daemon_args(self, test, node) -> list:
         return []
 
-    def setup(self, test, node) -> None:
-        remote = test["remote"]
+    def start(self, test, node) -> None:
+        """Start (or restart) the daemon — the single invocation both
+        setup and kill/restart nemeses use, so they can't drift."""
         d = self.suite.dir(test, node)
-        cu.install_archive(remote, node, self.resolve_url(test), d,
-                           sudo=self.suite.sudo(test))
         cu.start_daemon(
-            remote, node, f"{d}/{self.binary}",
+            test["remote"], node, f"{d}/{self.binary}",
             *self.daemon_args(test, node),
             logfile=f"{d}/{self.log_name}",
             pidfile=f"{d}/{self.pid_name}",
             chdir=d,
         )
+
+    def setup(self, test, node) -> None:
+        remote = test["remote"]
+        d = self.suite.dir(test, node)
+        cu.install_archive(remote, node, self.resolve_url(test), d,
+                           sudo=self.suite.sudo(test))
+        self.start(test, node)
         self.await_ready(test, node)
         self.post_start(test, node)
 

@@ -19,6 +19,7 @@ tiny HTTP _sql wrapper, with `_version` managed by the engine.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import random
@@ -268,8 +269,58 @@ class LostUpdatesClient(client.Client):
         pass
 
 
+class DirtyReadClient(client.Client):
+    """crate/dirty_read.clj:40-95: writes insert ids; reads probe a
+    specific id (:ok iff present); refresh flushes the table; the
+    strong read selects every id. Checked with the shared dirty-read
+    set algebra (same anomaly family as the elasticsearch workload)."""
+
+    def __init__(self, conn=None, flag=None):
+        self.conn = conn
+        self.flag = flag or _shared_flag()
+
+    def open(self, test, node):
+        conn = CrateConn(node_host(test, node), node_port(test, node))
+        me = DirtyReadClient(conn, self.flag)
+
+        def create():
+            conn.sql("drop table if exists dirty_read")
+            conn.sql("create table dirty_read (id int primary key)")
+
+        _once(self.flag, create)
+        return me
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                self.conn.sql(
+                    f"insert into dirty_read (id) values ({op.value})")
+                return op.with_(type="ok")
+            if op.f == "read":
+                rows = self.conn.sql(
+                    f"select id from dirty_read where id = {op.value}"
+                )["rows"]
+                return op.with_(type="ok" if rows else "fail")
+            if op.f == "refresh":
+                try:
+                    self.conn.sql("refresh table dirty_read")
+                except CrateError:
+                    pass  # the sim has no refresh lag; real crate does
+                return op.with_(type="ok")
+            if op.f == "strong-read":
+                ids = sorted(int(r[0]) for r in self.conn.sql(
+                    "select id from dirty_read")["rows"])
+                return op.with_(type="ok", value=ids)
+            raise ValueError(f"unknown op {op.f!r}")
+        except (CrateError, socket.timeout, TimeoutError, OSError) as e:
+            crash = "info" if op.f == "write" else "fail"
+            return op.with_(type=crash, error=str(e))
+
+    def close(self, test):
+        pass
+
+
 def workloads(opts: dict | None = None) -> dict:
-    import itertools
 
     opts = opts or {}
     n_keys = opts.get("keys", 4)
@@ -295,6 +346,19 @@ def workloads(opts: dict | None = None) -> dict:
                 "multiversion": MultiversionChecker(),
             }),
         },
+        "dirty-read": {
+            "client": DirtyReadClient(),
+            "during": gen.stagger(
+                0.02, _dirty_rw_gen()),
+            "final": gen.each(lambda: gen.seq([
+                gen.once({"type": "invoke", "f": "refresh"}),
+                gen.once({"type": "invoke", "f": "strong-read"}),
+            ])),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+                "dirty-read": _es_dirty_read_checker(),
+            }),
+        },
         "lost-updates": {
             "client": LostUpdatesClient(),
             # a FIXED key set so the final phase can read every key
@@ -317,6 +381,22 @@ def workloads(opts: dict | None = None) -> dict:
             }),
         },
     }
+
+
+def _dirty_rw_gen():
+    """Shared with the elasticsearch suite — identical workload
+    shape."""
+    from .elasticsearch import dirty_rw_gen
+
+    return dirty_rw_gen()
+
+
+def _es_dirty_read_checker():
+    """The dirty-read set-algebra checker is shared with the
+    elasticsearch suite (identical anomaly definition)."""
+    from .elasticsearch import DirtyReadChecker
+
+    return DirtyReadChecker()
 
 
 def crate_test(opts: dict) -> dict:

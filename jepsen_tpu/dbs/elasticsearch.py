@@ -116,11 +116,26 @@ class EsConn:
     def refresh(self) -> None:
         self.request("POST", f"/{INDEX}/_refresh")
 
-    def search_all(self) -> list:
-        body = self.request("POST", f"/{INDEX}/_search",
-                            body={"query": {"match_all": {}},
-                                  "size": 10000})
-        return [h["_source"] for h in body["hits"]["hits"]]
+    def search_all(self, page_size: int = 10000) -> list:
+        """Every document, paginated with search_after on _id — a
+        single size-capped request silently truncates past 10k docs,
+        which would make the dirty-read checker report false losses."""
+        out = []
+        after = None
+        while True:
+            body = {"query": {"match_all": {}}, "size": page_size,
+                    "sort": [{"_id": "asc"}]}
+            if after is not None:
+                body["search_after"] = [after]
+            resp = self.request("POST", f"/{INDEX}/_search", body=body)
+            hits = resp["hits"]["hits"]
+            out.extend(h["_source"] for h in hits)
+            if len(hits) < page_size:
+                return out
+            last = hits[-1].get("_id")
+            if last is None or last == after:
+                return out  # server ignored the cursor: stop honestly
+            after = last
 
 
 class RegisterClient(client.Client):

@@ -87,6 +87,13 @@ class Handler(BaseHTTPRequestHandler):
             return self._reply(200, {"_shards": {"failed": 0}})
         if parts and parts[-1] == "_search":
             index = parts[0] if len(parts) > 1 else None
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError:
+                body = {}
+            if not isinstance(body, dict):
+                body = {}
 
             def search(data):
                 docs = (data.get("indices") or {}).get(index) or {}
@@ -98,6 +105,14 @@ class Handler(BaseHTTPRequestHandler):
                     for i, d in docs.items()
                     if d["seq"] <= horizon
                 ]
+                hits.sort(key=lambda h: str(h["_id"]))
+                after = body.get("search_after")
+                if after:
+                    hits = [h for h in hits
+                            if str(h["_id"]) > str(after[0])]
+                size = body.get("size")
+                if isinstance(size, int) and size >= 0:
+                    hits = hits[:size]
                 return hits, None
 
             hits = self.store.transact(search)

@@ -453,3 +453,82 @@ class TestRabbitMQ:
         )
         result = core.run(t)
         assert result["results"]["valid"] is True, result["results"]
+
+
+class TestAerospikeKillNemesis:
+    def test_bounded_kill_and_restart(self, tmp_path):
+        nodes = ["n1", "n2", "n3"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "as.tar.gz")
+        aerospike_sim.build_archive(archive, str(tmp_path / "s" / "a.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        }
+        db = aerospike.AerospikeDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "aerospike": cfg}
+        for n in nodes:
+            db.setup(test, n)
+        try:
+            nem = aerospike.kill_nemesis(db, max_dead=2)
+            out = nem.invoke(test, Op(
+                "nemesis", "invoke", "kill", ["n1", "n2", "n3"]))
+            # bounded: only two may die, one stays alive
+            vals = list(out.value.values())
+            assert vals.count("killed") == 2
+            assert vals.count("still-alive") == 1
+            # dead nodes really are down; the survivor answers
+            import jepsen_tpu.dbs.aerospike_proto as ap_mod
+            alive = [n for n, v in out.value.items()
+                     if v == "still-alive"]
+            conn = ap_mod.AerospikeConn(
+                "127.0.0.1", cfg["ports"][alive[0]],
+                timeout=2.0, connect_timeout=2.0)
+            conn.get("probe")
+            conn.close()
+            # restart revives everyone
+            out = nem.invoke(test, Op(
+                "nemesis", "invoke", "restart", ["n1", "n2", "n3"]))
+            assert set(out.value.values()) == {"started"}
+            assert not nem.dead
+            for n in nodes:
+                db.await_ready(test, n)  # restart needs bind time
+        finally:
+            for n in nodes:
+                db.teardown(test, n)
+
+
+class TestCrateDirtyRead:
+    def test_client_and_full_run(self, tmp_path):
+        from jepsen_tpu.dbs import crate, crate_sim
+
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "crate.tar.gz")
+        crate_sim.build_archive(archive, str(tmp_path / "s" / "c.json"))
+        t = crate.crate_test({
+            "workload": "dirty-read",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "crate": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 4,
+            "quiesce": 0.2,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        strong = [o for o in result["history"]
+                  if o.type == "ok" and o.f == "strong-read"]
+        assert strong and strong[-1].value

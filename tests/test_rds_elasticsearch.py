@@ -304,3 +304,14 @@ class TestEsDirtyRead:
         result = core.run(t)
         res = result["results"]
         assert res["valid"] is True, res
+
+
+class TestSearchPagination:
+    def test_search_all_paginates_past_page_size(self, es_port):
+        conn = es.EsConn("127.0.0.1", es_port)
+        for i in range(25):
+            conn.index_doc(f"{i:03d}", {"id": i}, create=True)
+        conn.refresh()
+        # page size 10 forces three pages via search_after
+        out = conn.search_all(page_size=10)
+        assert sorted(d["id"] for d in out) == list(range(25))
