@@ -18,14 +18,13 @@ import itertools
 import logging
 import random
 import socket
-import threading
 
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, models, nemesis, osdist
 from ..control import util as cu
 from ..history import Op
 from . import aerospike_proto as ap
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, ArchiveKillNemesis, SuiteCfg
 
 log = logging.getLogger("jepsen_tpu.dbs.aerospike")
 
@@ -169,55 +168,21 @@ class CounterClient(client.Client):
             self.conn.close()
 
 
-class KillNemesis(nemesis.Nemesis):
+class KillNemesis(ArchiveKillNemesis):
     """The reference's bounded-dead-set killer
-    (aerospike/src/aerospike/nemesis.clj:17-58): :kill stops asd on the
-    named nodes but only while the dead set stays under max_dead (so a
-    majority survives); :restart revives them through the daemon
-    machinery; :revive/:recluster issue the server maintenance commands
-    best-effort."""
+    (aerospike/src/aerospike/nemesis.clj:17-58): the generic ArchiveDB
+    kill/restart plus aerospike's :revive/:recluster maintenance
+    commands (issued best-effort via asinfo at the node's address)."""
 
-    def __init__(self, db: "AerospikeDB", max_dead: int = 2):
-        self.db = db
-        self.max_dead = max_dead
-        self.dead: set = set()
-        self._lock = threading.Lock()
-
-    def invoke(self, test, op):
-        remote = test["remote"]
-        targets = list(op.value or test["nodes"])
-        results = {}
-        for node in targets:
-            if op.f == "kill":
-                with self._lock:
-                    if node in self.dead or len(self.dead) < self.max_dead:
-                        self.dead.add(node)
-                        allowed = True
-                    else:
-                        allowed = False
-                if allowed:
-                    d = _suite.dir(test, node)
-                    cu.stop_daemon(remote, node,
-                                   f"{d}/{self.db.pid_name}")
-                    results[node] = "killed"
-                else:
-                    results[node] = "still-alive"
-            elif op.f == "restart":
-                self.db.start(test, node)
-                with self._lock:
-                    self.dead.discard(node)
-                results[node] = "started"
-            elif op.f in ("revive", "recluster"):
-                r = remote.exec(
-                    node,
-                    ["asinfo", "-h", node_host(test, node),
-                     "-p", str(node_port(test, node)), "-v", op.f],
-                    check=False)
-                results[node] = ("ok" if getattr(r, "ok", False)
-                                 else "not-running")
-            else:
-                raise ValueError(f"kill nemesis can't handle {op.f!r}")
-        return op.with_(type="info", value=results)
+    def extra_op(self, test, node, op):
+        if op.f in ("revive", "recluster"):
+            r = test["remote"].exec(
+                node,
+                ["asinfo", "-h", node_host(test, node),
+                 "-p", str(node_port(test, node)), "-v", op.f],
+                check=False)
+            return "ok" if getattr(r, "ok", False) else "not-running"
+        return super().extra_op(test, node, op)
 
 
 def kill_nemesis(db: "AerospikeDB", max_dead: int = 2) -> KillNemesis:

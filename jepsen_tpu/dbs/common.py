@@ -9,6 +9,7 @@ once instead of once per suite."""
 from __future__ import annotations
 
 import logging
+import threading
 import time
 
 from .. import db
@@ -140,6 +141,65 @@ def once(flag: dict, fn) -> None:
         if not flag["created"]:
             fn()
             flag["created"] = True
+
+
+class ArchiveKillNemesis:
+    """Bounded-dead-set kill/restart for any ArchiveDB suite (the
+    aerospike reference's kill-nemesis shape, nemesis.clj:17-58,
+    generalized): :kill stops the daemon on the named nodes while the
+    dead set stays under max_dead (a majority survives); :restart
+    revives them via the DB's own start() so the invocation can't
+    drift from setup. Subclasses add suite-specific maintenance ops via
+    extra_op()."""
+
+    def __init__(self, db: ArchiveDB, max_dead: int = 2):
+        self.db = db
+        self.max_dead = max_dead
+        self.dead: set = set()
+        self._lock = threading.Lock()
+
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        remote = test["remote"]
+        targets = list(op.value or test["nodes"])
+        results = {}
+        for node in targets:
+            if op.f == "kill":
+                with self._lock:
+                    if node in self.dead or len(self.dead) < self.max_dead:
+                        self.dead.add(node)
+                        allowed = True
+                    else:
+                        allowed = False
+                if allowed:
+                    d = self.db.suite.dir(test, node)
+                    cu.stop_daemon(remote, node,
+                                   f"{d}/{self.db.pid_name}")
+                    results[node] = "killed"
+                else:
+                    results[node] = "still-alive"
+            elif op.f == "restart":
+                self.db.start(test, node)
+                with self._lock:
+                    self.dead.discard(node)
+                results[node] = "started"
+            else:
+                results[node] = self.extra_op(test, node, op)
+        return op.with_(type="info", value=results)
+
+    def extra_op(self, test, node, op):
+        raise ValueError(
+            f"{type(self).__name__} can't handle {op.f!r}")
+
+    def teardown(self, test):
+        pass
+
+
+def archive_kill_nemesis(db: ArchiveDB,
+                         max_dead: int = 2) -> ArchiveKillNemesis:
+    return ArchiveKillNemesis(db, max_dead)
 
 
 def resp_ping_ready(suite: SuiteCfg, test, node,

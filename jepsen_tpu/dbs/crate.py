@@ -35,6 +35,11 @@ from ..checker import Checker
 from ..history import Op, ops as _ops
 from .common import ArchiveDB, SuiteCfg, once as _once, \
     shared_flag as _shared_flag
+# shared with the elasticsearch suite — identical workload shape and
+# anomaly definition (no circular import: elasticsearch doesn't import
+# crate)
+from .elasticsearch import DirtyReadChecker as _EsDirtyReadChecker
+from .elasticsearch import dirty_rw_gen as _es_dirty_rw_gen
 
 log = logging.getLogger("jepsen_tpu.dbs.crate")
 
@@ -304,8 +309,12 @@ class DirtyReadClient(client.Client):
             if op.f == "refresh":
                 try:
                     self.conn.sql("refresh table dirty_read")
-                except CrateError:
-                    pass  # the sim has no refresh lag; real crate does
+                except CrateError as e:
+                    # the sim's engine doesn't know the statement (no
+                    # refresh lag there); any OTHER failure is real and
+                    # must not masquerade as a successful refresh
+                    if "can't parse statement" not in str(e):
+                        return op.with_(type="fail", error=str(e))
                 return op.with_(type="ok")
             if op.f == "strong-read":
                 ids = sorted(int(r[0]) for r in self.conn.sql(
@@ -349,14 +358,14 @@ def workloads(opts: dict | None = None) -> dict:
         "dirty-read": {
             "client": DirtyReadClient(),
             "during": gen.stagger(
-                0.02, _dirty_rw_gen()),
+                0.02, _es_dirty_rw_gen()),
             "final": gen.each(lambda: gen.seq([
                 gen.once({"type": "invoke", "f": "refresh"}),
                 gen.once({"type": "invoke", "f": "strong-read"}),
             ])),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
-                "dirty-read": _es_dirty_read_checker(),
+                "dirty-read": _EsDirtyReadChecker(),
             }),
         },
         "lost-updates": {
@@ -381,22 +390,6 @@ def workloads(opts: dict | None = None) -> dict:
             }),
         },
     }
-
-
-def _dirty_rw_gen():
-    """Shared with the elasticsearch suite — identical workload
-    shape."""
-    from .elasticsearch import dirty_rw_gen
-
-    return dirty_rw_gen()
-
-
-def _es_dirty_read_checker():
-    """The dirty-read set-algebra checker is shared with the
-    elasticsearch suite (identical anomaly definition)."""
-    from .elasticsearch import DirtyReadChecker
-
-    return DirtyReadChecker()
 
 
 def crate_test(opts: dict) -> dict:

@@ -116,15 +116,23 @@ class EsConn:
     def refresh(self) -> None:
         self.request("POST", f"/{INDEX}/_refresh")
 
-    def search_all(self, page_size: int = 10000) -> list:
-        """Every document, paginated with search_after on _id — a
-        single size-capped request silently truncates past 10k docs,
-        which would make the dirty-read checker report false losses."""
+    def search_all(self, page_size: int = 10000,
+                   sort_field: str | None = None) -> list:
+        """Every document. With sort_field (an INDEXED source field —
+        real Elasticsearch rejects sorting on _id), results paginate
+        via search_after so >10k-doc indexes aren't silently truncated;
+        without one, a single size-capped request is issued (the set
+        workload's scale)."""
+        if sort_field is None:
+            resp = self.request("POST", f"/{INDEX}/_search",
+                                body={"query": {"match_all": {}},
+                                      "size": page_size})
+            return [h["_source"] for h in resp["hits"]["hits"]]
         out = []
         after = None
         while True:
             body = {"query": {"match_all": {}}, "size": page_size,
-                    "sort": [{"_id": "asc"}]}
+                    "sort": [{sort_field: "asc"}]}
             if after is not None:
                 body["search_after"] = [after]
             resp = self.request("POST", f"/{INDEX}/_search", body=body)
@@ -132,7 +140,7 @@ class EsConn:
             out.extend(h["_source"] for h in hits)
             if len(hits) < page_size:
                 return out
-            last = hits[-1].get("_id")
+            last = hits[-1]["_source"].get(sort_field)
             if last is None or last == after:
                 return out  # server ignored the cursor: stop honestly
             after = last
@@ -239,7 +247,8 @@ class DirtyReadClient(client.Client):
                 self.conn.refresh()
                 return op.with_(type="ok")
             if op.f == "strong-read":
-                ids = sorted(d["id"] for d in self.conn.search_all()
+                ids = sorted(d["id"] for d in
+                             self.conn.search_all(sort_field="id")
                              if "id" in d)
                 return op.with_(type="ok", value=ids)
             raise ValueError(f"unknown op {op.f!r}")

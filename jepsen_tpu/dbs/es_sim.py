@@ -105,11 +105,24 @@ class Handler(BaseHTTPRequestHandler):
                     for i, d in docs.items()
                     if d["seq"] <= horizon
                 ]
-                hits.sort(key=lambda h: str(h["_id"]))
-                after = body.get("search_after")
-                if after:
-                    hits = [h for h in hits
-                            if str(h["_id"]) > str(after[0])]
+                sort = body.get("sort") or []
+                field = None
+                for entry in sort:
+                    if isinstance(entry, dict) and entry:
+                        field = next(iter(entry))
+                        break
+                if field:
+                    def sort_key(h, field=field):
+                        return (h["_source"].get(field)
+                                if field != "_id" else str(h["_id"]))
+
+                    hits.sort(key=lambda h: (sort_key(h) is None,
+                                             sort_key(h)))
+                    after = body.get("search_after")
+                    if after:
+                        hits = [h for h in hits
+                                if sort_key(h) is not None
+                                and sort_key(h) > after[0]]
                 size = body.get("size")
                 if isinstance(size, int) and size >= 0:
                     hits = hits[:size]

@@ -532,3 +532,45 @@ class TestCrateDirtyRead:
         strong = [o for o in result["history"]
                   if o.type == "ok" and o.f == "strong-read"]
         assert strong and strong[-1].value
+
+
+class TestGenericArchiveKillNemesis:
+    def test_any_archive_suite_gets_kill_restart(self, tmp_path):
+        """The generic bounded killer works on any ArchiveDB suite —
+        here, tidb's mysql-protocol sim cluster."""
+        from jepsen_tpu.dbs import mysql_sim, tidb
+        from jepsen_tpu.dbs.common import archive_kill_nemesis
+
+        nodes = ["n1", "n2", "n3"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "tidb.tar.gz")
+        mysql_sim.build_archive(archive, str(tmp_path / "s" / "m.json"),
+                                binary="tidb-server")
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        }
+        db = tidb.TidbDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "tidb": cfg}
+        for n in nodes:
+            db.setup(test, n)
+        try:
+            nem = archive_kill_nemesis(db, max_dead=1)
+            out = nem.invoke(test, Op("nemesis", "invoke", "kill", nodes))
+            vals = list(out.value.values())
+            assert vals.count("killed") == 1
+            assert vals.count("still-alive") == 2
+            out = nem.invoke(test, Op("nemesis", "invoke", "restart",
+                                      nodes))
+            assert set(out.value.values()) == {"started"}
+            for n in nodes:
+                db.await_ready(test, n)
+            # unknown fs raise
+            with pytest.raises(ValueError):
+                nem.invoke(test, Op("nemesis", "invoke", "detonate",
+                                    ["n1"]))
+        finally:
+            for n in nodes:
+                db.teardown(test, n)

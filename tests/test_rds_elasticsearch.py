@@ -312,6 +312,9 @@ class TestSearchPagination:
         for i in range(25):
             conn.index_doc(f"{i:03d}", {"id": i}, create=True)
         conn.refresh()
-        # page size 10 forces three pages via search_after
-        out = conn.search_all(page_size=10)
+        # page size 10 forces three pages via search_after on the
+        # indexed "id" field (real ES rejects sorting on _id)
+        out = conn.search_all(page_size=10, sort_field="id")
         assert sorted(d["id"] for d in out) == list(range(25))
+        # the unsorted single-request path still works for small sets
+        assert len(conn.search_all()) == 25
