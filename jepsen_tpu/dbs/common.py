@@ -202,6 +202,54 @@ def archive_kill_nemesis(db: ArchiveDB,
     return ArchiveKillNemesis(db, max_dead)
 
 
+class StartKillNemesis(ArchiveKillNemesis):
+    """ArchiveKillNemesis behind the partitioner's start/stop op
+    convention (the shape tidb/nemesis.clj:134-142's startkill takes):
+    :start kills up to n random nodes, :stop restarts whatever died."""
+
+    def __init__(self, db: ArchiveDB, n: int = 1):
+        super().__init__(db, max_dead=n)
+        self.n = n
+
+    def invoke(self, test, op):
+        import random as _random
+
+        if op.f == "start":
+            nodes = list(test["nodes"])
+            targets = _random.sample(nodes, min(self.n, len(nodes)))
+            return super().invoke(test, op.with_(f="kill",
+                                                 value=targets)
+                                  ).with_(f="start")
+        if op.f == "stop":
+            with self._lock:
+                targets = sorted(self.dead)
+            if not targets:
+                # nothing died since the last stop: a bare [] would
+                # fall through invoke's "falsy means all nodes" default
+                # and restart every healthy daemon
+                return op.with_(type="info", value={})
+            out = super().invoke(test, op.with_(f="restart",
+                                                value=targets))
+            return out.with_(f="stop")
+        return super().invoke(test, op)
+
+
+def standard_nemeses(db: ArchiveDB) -> dict:
+    """The named-nemesis registry the per-DB runners share (the
+    cockroach/tidb registries' common core, nemesis.clj:110-144):
+    partitions, majorities-ring, SIGSTOP pauses, bounded kill+restart."""
+    from .. import nemesis as nem
+
+    return {
+        "none": lambda: nem.noop,
+        "parts": nem.partition_random_halves,
+        "majority-ring": nem.partition_majorities_ring,
+        "start-stop": lambda: nem.hammer_time(db.binary),
+        "start-kill": lambda: StartKillNemesis(db, 1),
+        "start-kill-2": lambda: StartKillNemesis(db, 2),
+    }
+
+
 def resp_ping_ready(suite: SuiteCfg, test, node,
                     timeout: float = 2.0) -> bool:
     """Readiness probe for RESP-protocol suites (disque, raftis)."""

@@ -207,7 +207,8 @@ class SetClient(client.Client):
             if op.f == "read":
                 self.conn.refresh()
                 values = sorted(
-                    d["num"] for d in self.conn.search_all()
+                    d["num"] for d in
+                    self.conn.search_all(sort_field="num")
                     if "num" in d)
                 return op.with_(type="ok", value=values)
             raise ValueError(f"unknown op {op.f!r}")

@@ -335,7 +335,7 @@ def make_sql_suite(name: str, default_port: int, binary: str,
     """Build (suite_cfg, DBClass, workloads_fn, test_fn, opt_spec) for a
     MySQL-protocol suite."""
     from .. import checker as checker_mod
-    from .. import models, nemesis, osdist
+    from .. import models, osdist
     from .common import ArchiveDB, SuiteCfg
 
     suite = SuiteCfg(name, default_port, f"/opt/{name}")
@@ -441,9 +441,13 @@ def make_sql_suite(name: str, default_port: int, binary: str,
 
     def test_fn(opts: dict) -> dict:
         from ..testlib import noop_test
+        from .common import standard_nemeses
 
         wl_name = opts.get("workload", workload_names[0])
         wl = workloads(opts)[wl_name]
+        db = DB(archive_url=opts.get("archive_url"))
+        nem_client = standard_nemeses(db)[
+            opts.get("nemesis") or "parts"]()
         generator = gen.time_limit(
             opts.get("time_limit", 60),
             gen.nemesis(gen.start_stop(10, 10), wl["during"]),
@@ -458,9 +462,9 @@ def make_sql_suite(name: str, default_port: int, binary: str,
             {
                 "name": f"{display_name or name} {wl_name}",
                 "os": osdist.debian,
-                "db": DB(archive_url=opts.get("archive_url")),
+                "db": db,
                 "client": wl["client"],
-                "nemesis": nemesis.partition_random_halves(),
+                "nemesis": nem_client,
                 "model": wl.get("model"),
                 "generator": gen.phases(*phases),
                 "checker": checker_mod.compose({
@@ -477,6 +481,10 @@ def make_sql_suite(name: str, default_port: int, binary: str,
     def opt_spec(p) -> None:
         p.add_argument("--workload", default=workload_names[0],
                        choices=sorted(workload_names))
+        p.add_argument("--nemesis", default="parts",
+                       choices=["none", "parts", "majority-ring",
+                                "start-stop", "start-kill",
+                                "start-kill-2"])
         p.add_argument("--archive-url", dest="archive_url", default=None)
         p.add_argument("--accounts", type=int, default=5)
         p.add_argument("--starting-balance", dest="starting_balance",

@@ -215,3 +215,58 @@ class TestBundles:
         t = tidb.tidb_test({"workload": "register", "nodes": ["a"],
                             "time_limit": 5})
         assert t["name"] == "tidb register"
+
+
+class TestStandardNemeses:
+    def test_registry_shape(self):
+        from jepsen_tpu.dbs.common import standard_nemeses
+
+        db = tidb.TidbDB(archive_url="file:///x")
+        reg = standard_nemeses(db)
+        assert set(reg) == {"none", "parts", "majority-ring",
+                            "start-stop", "start-kill", "start-kill-2"}
+        for name, factory in reg.items():
+            assert factory() is not None, name
+
+    def test_start_kill_adapter_end_to_end(self, tmp_path):
+        """start kills a bounded subset, stop restarts exactly the
+        dead — on a live tidb sim cluster."""
+        from jepsen_tpu.dbs.common import StartKillNemesis
+
+        nodes = ["n1", "n2", "n3"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "tidb.tar.gz")
+        mysql_sim.build_archive(archive, str(tmp_path / "s" / "m.json"),
+                                binary="tidb-server")
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        }
+        db = tidb.TidbDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "tidb": cfg}
+        for n in nodes:
+            db.setup(test, n)
+        try:
+            nem = StartKillNemesis(db, n=1)
+            out = nem.invoke(test, Op("nemesis", "invoke", "start", None))
+            assert out.f == "start"
+            assert list(out.value.values()).count("killed") == 1
+            dead = next(n for n, v in out.value.items()
+                        if v == "killed")
+            out = nem.invoke(test, Op("nemesis", "invoke", "stop", None))
+            assert out.f == "stop" and out.value == {dead: "started"}
+            for n in nodes:
+                db.await_ready(test, n)
+        finally:
+            for n in nodes:
+                db.teardown(test, n)
+
+    def test_suite_accepts_nemesis_option(self):
+        t = galera.galera_test({"workload": "bank", "nodes": ["a"],
+                                "nemesis": "start-kill",
+                                "time_limit": 5})
+        from jepsen_tpu.dbs.common import StartKillNemesis
+
+        assert isinstance(t["nemesis"], StartKillNemesis)
