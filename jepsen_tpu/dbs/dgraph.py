@@ -22,6 +22,7 @@ import urllib.request
 
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, independent, nemesis, osdist
+from .. import trace
 from ..checker import Checker
 from ..history import Op, ops as _ops
 from .common import ArchiveDB, SuiteCfg
@@ -67,14 +68,17 @@ class DgraphConn:
         self.timeout = timeout
 
     def _post(self, path: str, body: dict) -> dict:
-        req = urllib.request.Request(
-            self.base + path, data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            out = json.load(resp)
-        if out.get("errors"):
-            raise DgraphError(out["errors"][0].get("message", "error"))
-        return out
+        # Spans around every wire call, like the reference's client
+        # wraps each query/mutation (dgraph/trace.clj:43-53).
+        with trace.with_trace(f"dgraph.client{path}"):
+            req = urllib.request.Request(
+                self.base + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.load(resp)
+            if out.get("errors"):
+                raise DgraphError(out["errors"][0].get("message", "error"))
+            return out
 
     def alter(self, schema: str) -> None:
         self._post("/alter", {"schema": schema})
@@ -228,6 +232,9 @@ def workloads(opts: dict) -> dict:
 def dgraph_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    # Configure span tracing (dgraph/core.clj wires trace/tracing from
+    # the CLI's --tracing endpoint; here the endpoint is a JSONL path).
+    trace.tracing(opts.get("tracing"))
     wl = workloads(opts)[opts.get("workload", "set")]
     generator = gen.time_limit(
         opts.get("time_limit", 60),
@@ -260,6 +267,8 @@ def _opt_spec(p) -> None:
     p.add_argument("--workload", default="set",
                    choices=["set", "upsert"])
     p.add_argument("--archive-url", dest="archive_url", default=None)
+    p.add_argument("--tracing", default=None, metavar="SPANS_JSONL",
+                   help="export client/nemesis spans to this JSONL file")
 
 
 def main(argv=None) -> None:

@@ -1,0 +1,75 @@
+"""Tracing subsystem (dgraph trace.clj analog)."""
+
+import json
+import threading
+
+from jepsen_tpu import trace
+
+
+def setup_function(_fn):
+    trace.tracing(None)
+    trace.drain()
+
+
+def test_disabled_is_noop():
+    trace.tracing(None)
+    with trace.with_trace("nothing") as span:
+        assert span is None
+        assert trace.context() == {"span_id": "0" * 16,
+                                   "trace_id": "0" * 16}
+    assert trace.drain() == []
+
+
+def test_span_nesting_and_export(tmp_path):
+    out = tmp_path / "spans.jsonl"
+    cfg = trace.tracing(str(out))
+    assert cfg["config"] is True and cfg["exporter"] == str(out)
+    with trace.with_trace("outer") as outer:
+        ctx = trace.context()
+        assert ctx["span_id"] == outer.span_id
+        with trace.with_trace("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+            trace.annotate("hello")
+            trace.attribute("node", "n1")
+    spans = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [s["operationName"] for s in spans] == ["inner", "outer"]
+    assert spans[0]["tags"] == {"node": "n1"}
+    assert spans[0]["logs"][0]["fields"] == "hello"
+    assert spans[0]["parentSpanID"] == spans[1]["spanID"]
+    assert all(s["duration"] >= 0 for s in spans)
+
+
+def test_attribute_requires_strings(tmp_path):
+    trace.tracing(str(tmp_path / "s.jsonl"))
+    with trace.with_trace("x"):
+        try:
+            trace.attribute("k", 5)
+        except TypeError:
+            pass
+        else:
+            raise AssertionError("non-string attribute accepted")
+
+
+def test_attribute_annotate_are_noops_without_a_span():
+    trace.tracing(None)
+    trace.attribute("k", 3)  # non-string value: still safe when no span
+    trace.annotate("nothing")
+    assert trace.drain() == []
+
+
+def test_threads_do_not_share_span_stacks(tmp_path):
+    trace.tracing(str(tmp_path / "s.jsonl"))
+    seen = {}
+
+    def worker(name):
+        with trace.with_trace(name):
+            seen[name] = trace.context()
+
+    with trace.with_trace("main"):
+        t = threading.Thread(target=worker, args=("side",))
+        t.start()
+        t.join()
+        main_ctx = trace.context()
+    # The side thread's span is a fresh root, not a child of "main".
+    assert seen["side"]["trace_id"] != main_ctx["trace_id"]
