@@ -13,11 +13,13 @@ Workloads:
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import logging
 import socket
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from .. import checker as checker_mod
@@ -67,15 +69,29 @@ class DgraphConn:
         self.base = f"http://{host}:{port}"
         self.timeout = timeout
 
-    def _post(self, path: str, body: dict) -> dict:
+    def _post(self, path: str, body: dict, params: dict | None = None) -> dict:
         # Spans around every wire call, like the reference's client
         # wraps each query/mutation (dgraph/trace.clj:43-53).
         with trace.with_trace(f"dgraph.client{path}"):
+            url = self.base + path
+            if params:
+                url += "?" + urllib.parse.urlencode(params)
             req = urllib.request.Request(
-                self.base + path, data=json.dumps(body).encode(),
+                url, data=json.dumps(body).encode(),
                 headers={"Content-Type": "application/json"}, method="POST")
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                out = json.load(resp)
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    out = json.load(resp)
+            except urllib.error.HTTPError as e:
+                try:
+                    out = json.load(e)
+                except json.JSONDecodeError:
+                    raise DgraphError(f"HTTP {e.code}") from e
+                msg = (out.get("errors") or [{}])[0].get("message", "")
+                if "aborted" in msg.lower():
+                    # client.clj:105-167 maps this to :fail :conflict
+                    raise TxnConflict(msg) from e
+                raise DgraphError(msg or f"HTTP {e.code}") from e
             if out.get("errors"):
                 raise DgraphError(out["errors"][0].get("message", "error"))
             return out
@@ -85,6 +101,7 @@ class DgraphConn:
 
     def mutate(self, sets: list, query: str | None = None,
                cond: str | None = None) -> dict:
+        """One-shot (auto-commit) mutation."""
         body: dict = {"set": sets}
         if query is not None:
             body["query"] = query
@@ -95,9 +112,105 @@ class DgraphConn:
     def query(self, q: str) -> list:
         return self._post("/query", {"query": q})["data"]["q"]
 
+    def txn(self) -> "DgraphTxn":
+        return DgraphTxn(self)
+
+
+class DgraphTxn:
+    """A dgraph transaction: start_ts assigned by the server on first
+    contact, reads from that snapshot, mutations staged server-side,
+    commit detects write-write conflicts (client.clj:66-103's
+    Transaction object over the HTTP API)."""
+
+    def __init__(self, conn: DgraphConn):
+        self.conn = conn
+        self.start_ts = 0
+        self.finished = False
+
+    def _ts(self, out: dict) -> None:
+        ts = ((out.get("extensions") or {}).get("txn") or {}).get("start_ts")
+        if ts and not self.start_ts:
+            self.start_ts = int(ts)
+
+    def query(self, q: str) -> list:
+        out = self.conn._post("/query", {"query": q},
+                              params={"startTs": self.start_ts})
+        self._ts(out)
+        return out["data"]["q"]
+
+    def mutate(self, sets: list | None = None, dels: list | None = None,
+               query: str | None = None, cond: str | None = None) -> dict:
+        body: dict = {}
+        if sets:
+            body["set"] = sets
+        if dels:
+            body["delete"] = dels
+        if query is not None:
+            body["query"] = query
+        if cond is not None:
+            body["cond"] = cond
+        out = self.conn._post(
+            "/mutate", body,
+            params={"startTs": self.start_ts, "commitNow": "false"})
+        self._ts(out)
+        return out["data"]["uids"]
+
+    def commit(self) -> None:
+        """Commit; raises TxnConflict on a write-write conflict."""
+        if self.finished or not self.start_ts:
+            self.finished = True
+            return
+        self.finished = True
+        self.conn._post("/commit", {}, params={"startTs": self.start_ts})
+
+    def discard(self) -> None:
+        """Abort (client.clj:55-64's abort-txn!); idempotent."""
+        if self.finished or not self.start_ts:
+            self.finished = True
+            return
+        self.finished = True
+        try:
+            self.conn._post("/commit", {},
+                            params={"startTs": self.start_ts,
+                                    "abort": "true"})
+        except (DgraphError, urllib.error.URLError, OSError,
+                socket.timeout):
+            # Abort must never mask the body's exception — a dead or
+            # partitioned node makes the discard a best-effort no-op
+            # (client.clj:55-64 tolerates ABORTED the same way).
+            pass
+
+
+@contextlib.contextmanager
+def with_txn(conn: DgraphConn):
+    """Open a transaction, commit at the end of the body, discard on
+    exception (client.clj:66-89's with-txn macro)."""
+    t = conn.txn()
+    try:
+        yield t
+        t.commit()
+    finally:
+        t.discard()
+
+
+def with_conflict_as_fail(op: Op, fn):
+    """Run fn(); a transaction conflict completes `op` as :fail
+    :conflict instead of raising (client.clj:105-167). Other errors
+    follow the read-fail / write-indeterminate taxonomy at the call
+    site."""
+    try:
+        return fn()
+    except TxnConflict:
+        return op.with_(type="fail", error="conflict")
+
 
 class DgraphError(Exception):
     pass
+
+
+class TxnConflict(DgraphError):
+    """The server aborted the transaction at commit (write-write
+    conflict) — always safe to call :fail, the txn did not apply."""
 
 
 class SetClient(client.Client):

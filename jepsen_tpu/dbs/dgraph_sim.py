@@ -1,9 +1,26 @@
 """A hermetic Dgraph lookalike: the HTTP API subset the dgraph suite
-drives — /alter (schema accepted), /mutate with set-JSON and optional
-upsert query+cond, /query with a tiny DQL subset (func: has(pred) |
-eq(pred, val), fields uid + predicates), /health. Nodes are uid-keyed
-predicate maps in the shared flock store; mutations are atomic under
-the store lock, reproducing a serializable Zero."""
+drives — /alter (schema accepted), /mutate with set/delete JSON and
+optional upsert query+cond, /query with a tiny DQL subset (func:
+has(pred) | eq(pred, val), fields uid + predicates), /commit, /health,
+and /state (zero's group/tablet map, for the tablet-mover nemesis).
+
+Storage is MVCC over the shared flock store, reproducing dgraph's
+transaction model (reference client:
+/root/reference/dgraph/src/jepsen/dgraph/client.clj:66-103):
+
+- every node is a VERSION CHAIN [[commit_ts, preds-or-None], ...];
+- a transaction's first request is assigned a start_ts and reads the
+  snapshot as of that ts (snapshot isolation — reads may be stale but
+  are internally consistent);
+- /mutate?startTs=N&commitNow=false stages writes in the txn record;
+- /commit?startTs=N detects write-write conflicts via CONFLICT KEYS —
+  one per written uid plus one per written (predicate, value) pair,
+  which is how dgraph's @upsert index directive turns concurrent
+  insert-if-absent races into aborts — and answers HTTP 409
+  "Transaction has been aborted. Please retry." like the real server;
+- /mutate without startTs (or with commitNow=true) is a one-shot
+  atomic transaction, preserving the non-transactional clients.
+"""
 
 from __future__ import annotations
 
@@ -14,9 +31,12 @@ import re
 import sys
 import time
 import urllib.parse
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .simbase import Store, build_sim_archive
+
+ABORTED = "Transaction has been aborted. Please retry."
 
 
 def parse_func(query: str) -> tuple:
@@ -38,11 +58,31 @@ def parse_func(query: str) -> tuple:
     return m.group(1), m.group(2), value, fields
 
 
-def run_query(data: dict, query: str) -> list:
+def snapshot(data: dict, ts: int, overlay: dict | None = None) -> dict:
+    """Materialize {uid: preds} as of commit-ts <= ts, with a txn's own
+    staged writes overlaid (None = staged delete)."""
+    view = {}
+    for uid, chain in (data.get("nodes") or {}).items():
+        preds = None
+        for cts, p in chain:
+            if cts <= ts:
+                preds = p
+            else:
+                break
+        if preds is not None:
+            view[uid] = preds
+    for uid, preds in (overlay or {}).items():
+        if preds is None:
+            view.pop(uid, None)
+        else:
+            view[uid] = preds
+    return view
+
+
+def run_query(view: dict, query: str) -> list:
     func, pred, value, fields = parse_func(query)
-    nodes = data.get("nodes") or {}
     out = []
-    for uid, preds in nodes.items():
+    for uid, preds in view.items():
         if func == "has" and pred not in preds:
             continue
         if func == "eq" and preds.get(pred) != value:
@@ -55,6 +95,17 @@ def run_query(data: dict, query: str) -> list:
                 row[f] = preds[f]
         out.append(row)
     return out
+
+
+def conflict_keys(writes: dict) -> list:
+    """One key per written uid, one per written (pred, value) pair —
+    the sim's image of dgraph's uid- and index-level conflict keys."""
+    keys = []
+    for uid, preds in writes.items():
+        keys.append(f"u:{uid}")
+        for p, v in (preds or {}).items():
+            keys.append(f"pv:{p}={v!r}")
+    return keys
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -75,14 +126,41 @@ class Handler(BaseHTTPRequestHandler):
         self.wfile.write(payload)
 
     def do_GET(self):
-        if urllib.parse.urlparse(self.path).path == "/health":
+        path = urllib.parse.urlparse(self.path).path
+        if path == "/health":
             return self._reply(200, {"status": "healthy"})
+        if path == "/state":
+            # Zero's state: every predicate seen so far, assigned to
+            # one of two groups by hash — enough surface for the
+            # tablet-mover nemesis (dgraph/nemesis.clj:50-86).
+            def rd(data):
+                preds = set()
+                for chain in (data.get("nodes") or {}).values():
+                    for _, p in chain:
+                        preds.update((p or {}).keys())
+                moved = data.get("tablet_groups") or {}
+                groups: dict = {"1": {"tablets": {}}, "2": {"tablets": {}}}
+                for p in sorted(preds):
+                    # Stable across processes and runs (hash() is
+                    # PYTHONHASHSEED-randomized; the sim must be
+                    # deterministic for every node process).
+                    g = moved.get(p) or str(
+                        1 + (zlib.crc32(p.encode()) % 2))
+                    groups.setdefault(g, {"tablets": {}})
+                    groups[g]["tablets"][p] = {
+                        "predicate": p, "groupId": int(g)}
+                return {"groups": groups,
+                        "leader": data.get("leader") or "n1"}, None
+
+            return self._reply(200, self.store.transact(rd))
         self._reply(404, {"errors": [{"message": "no route"}]})
 
     def do_POST(self):
         if self.mean_latency > 0:
             time.sleep(random.expovariate(1.0 / self.mean_latency))
-        path = urllib.parse.urlparse(self.path).path
+        parsed = urllib.parse.urlparse(self.path)
+        path = parsed.path
+        params = dict(urllib.parse.parse_qsl(parsed.query))
         length = int(self.headers.get("Content-Length") or 0)
         try:
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -91,48 +169,200 @@ class Handler(BaseHTTPRequestHandler):
         if path == "/alter":
             return self._reply(200, {"data": {"code": "Success"}})
         if path == "/query":
-            def rd(data):
-                try:
-                    return run_query(data, body["query"]), None
-                except ValueError as e:
-                    return e, None
-
-            out = self.store.transact(rd)
-            if isinstance(out, Exception):
-                return self._reply(400, {"errors": [{"message": str(out)}]})
-            return self._reply(200, {"data": {"q": out}})
+            return self._query(body, params)
         if path == "/mutate":
-            return self._mutate(body)
+            return self._mutate(body, params)
+        if path == "/commit":
+            return self._commit(params)
+        if path == "/moveTablet":
+            return self._move_tablet(params)
         self._reply(404, {"errors": [{"message": "no route"}]})
 
-    def _mutate(self, body: dict) -> None:
+    # -- transactional plumbing --------------------------------------
+
+    @staticmethod
+    def _txn(data: dict, start_ts: int) -> dict | None:
+        return (data.get("txns") or {}).get(str(start_ts))
+
+    def _query(self, body: dict, params: dict) -> None:
+        start_ts = int(params.get("startTs") or 0)
+        transactional = "startTs" in params
+
+        def rd(data):
+            new = None
+            if start_ts:
+                ts = start_ts
+            elif transactional:
+                # startTs=0 from a txn's first contact: assign its
+                # start_ts, like dgraph returns extensions.txn.start_ts.
+                # No txn record yet — it's created lazily by the first
+                # staged mutate, so read-only txns leave no garbage.
+                ts = int(data.get("ts") or 0) + 1
+                new = dict(data)
+                new["ts"] = ts
+            else:
+                # Legacy non-transactional read: current snapshot, no
+                # state write (the read hot path stays pure).
+                ts = int(data.get("ts") or 0)
+            # Only a transactional read may overlay staged writes — a
+            # legacy read at ts == an open txn's start_ts must not see
+            # that txn's uncommitted data.
+            txn = (self._txn(data, ts) or {}) if transactional else {}
+            view = snapshot(data, ts, txn.get("writes"))
+            try:
+                return (run_query(view, body["query"]), ts), new
+            except ValueError as e:
+                return (e, ts), new
+
+        out, ts = self.store.transact(rd)
+        if isinstance(out, Exception):
+            return self._reply(400, {"errors": [{"message": str(out)}]})
+        return self._reply(200, {
+            "data": {"q": out},
+            "extensions": {"txn": {"start_ts": ts}},
+        })
+
+    def _mutate(self, body: dict, params: dict) -> None:
         sets = body.get("set") or []
+        dels = body.get("delete") or []
         upsert_query = body.get("query")
         cond = body.get("cond")
+        start_ts = int(params.get("startTs") or 0)
+        # Auto-commit when asked explicitly, or when the caller isn't
+        # transactional at all (no startTs AND no commitNow param — the
+        # legacy one-shot clients). startTs=0&commitNow=false is a
+        # txn's FIRST staged mutate: assign its start_ts below.
+        commit_now = (params.get("commitNow", "").lower() == "true"
+                      or ("commitNow" not in params and not start_ts))
 
         def mut(data):
-            nodes = dict(data.get("nodes") or {})
+            new = dict(data)
+            ts = start_ts
+            if not ts:
+                ts = int(data.get("ts") or 0) + 1
+                new["ts"] = ts
+            txns = dict(new.get("txns") or {})
+            txn = dict(txns.get(str(ts)) or {"writes": {}})
+            writes = dict(txn["writes"])
+            view = snapshot(data, ts, writes)
+
             if upsert_query is not None:
-                found = run_query(data, upsert_query)
+                found = run_query(view, upsert_query)
                 if cond is not None:
                     m = re.search(r"eq\(len\(\w+\),\s*(\d+)\)", cond)
                     want = int(m.group(1)) if m else 0
                     if len(found) != want:
-                        return {"data": {"code": "Success",
-                                         "uids": {}}}, None
-            uids = {}
-            counter = int(data.get("uid_counter") or 0)
-            for i, triple in enumerate(sets):
-                counter += 1
-                uid = f"0x{counter:x}"
-                nodes[uid] = {k: v for k, v in triple.items()
-                              if k != "uid"}
-                uids[f"blank-{i}"] = uid
-            new = dict(data)
-            new["nodes"], new["uid_counter"] = nodes, counter
-            return {"data": {"code": "Success", "uids": uids}}, new
+                        return ({"data": {"code": "Success", "uids": {}},
+                                 "extensions": {"txn": {"start_ts": ts}}},
+                                new if new != data else None)
 
-        self._reply(200, self.store.transact(mut))
+            uids = {}
+            counter = int(new.get("uid_counter") or 0)
+            for i, triple in enumerate(sets):
+                uid = triple.get("uid")
+                if uid is None:
+                    counter += 1
+                    uid = f"0x{counter:x}"
+                    uids[f"blank-{i}"] = uid
+                merged = dict(view.get(uid) or {})
+                merged.update(
+                    {k: v for k, v in triple.items() if k != "uid"})
+                writes[uid] = merged
+            for triple in dels:
+                uid = triple.get("uid")
+                if uid is not None and uid in view:
+                    writes[uid] = None
+            new["uid_counter"] = counter
+
+            if commit_now:
+                err, new2 = _apply_commit(new, ts, writes)
+                if err:
+                    return ({"_status": 409,
+                             "errors": [{"message": err}]}, None)
+                # Commit-on-last-mutate finishes the txn: drop any
+                # staged record so a later /commit can't replay it.
+                if str(ts) in (new2.get("txns") or {}):
+                    txns2 = dict(new2["txns"])
+                    txns2.pop(str(ts))
+                    new2 = dict(new2)
+                    new2["txns"] = txns2
+                return ({"data": {"code": "Success", "uids": uids},
+                         "extensions": {"txn": {"start_ts": ts}}}, new2)
+            txn["writes"] = writes
+            txns[str(ts)] = txn
+            new["txns"] = txns
+            return ({"data": {"code": "Success", "uids": uids},
+                     "extensions": {"txn": {"start_ts": ts}}}, new)
+
+        out = self.store.transact(mut)
+        status = out.pop("_status", 200)
+        self._reply(status, out)
+
+    def _commit(self, params: dict) -> None:
+        start_ts = int(params.get("startTs") or 0)
+        abort = params.get("abort", "").lower() == "true"
+
+        def com(data):
+            txns = dict(data.get("txns") or {})
+            txn = txns.pop(str(start_ts), None)
+            new = dict(data)
+            new["txns"] = txns
+            if txn is None or abort:
+                # Read-only commit or abort/discard: both succeed (a
+                # read-only txn has no record — see _query — and
+                # dgraph's discard of a finished txn is a no-op).
+                return ({"data": {"code": "Success"}}, new)
+            err, new2 = _apply_commit(new, start_ts, txn["writes"])
+            if err:
+                return ({"_status": 409,
+                         "errors": [{"message": err}]}, new)
+            return ({"data": {"code": "Success"},
+                     "extensions": {"txn": {"start_ts": start_ts,
+                                            "commit_ts": new2["ts"]}}},
+                    new2)
+
+        out = self.store.transact(com)
+        status = out.pop("_status", 200)
+        self._reply(status, out)
+
+    def _move_tablet(self, params: dict) -> None:
+        pred = params.get("tablet")
+        group = params.get("group")
+
+        def mv(data):
+            new = dict(data)
+            moved = dict(new.get("tablet_groups") or {})
+            moved[pred] = str(group)
+            new["tablet_groups"] = moved
+            return {"data": {"code": "Success",
+                             "message": f"moved {pred} to {group}"}}, new
+
+        self._reply(200, self.store.transact(mv))
+
+
+def _apply_commit(data: dict, start_ts: int, writes: dict):
+    """Conflict-check `writes` against commits after start_ts; on
+    success append new versions at a fresh commit_ts. Returns
+    (error-message-or-None, new-data)."""
+    ckeys = dict(data.get("ckeys") or {})
+    for key in conflict_keys(writes):
+        if ckeys.get(key, 0) > start_ts:
+            return ABORTED, None
+    if not writes:
+        return None, data
+    commit_ts = int(data.get("ts") or 0) + 1
+    new = dict(data)
+    new["ts"] = commit_ts
+    nodes = dict(new.get("nodes") or {})
+    for uid, preds in writes.items():
+        chain = list(nodes.get(uid) or [])
+        chain.append([commit_ts, preds])
+        nodes[uid] = chain
+    new["nodes"] = nodes
+    for key in conflict_keys(writes):
+        ckeys[key] = commit_ts
+    new["ckeys"] = ckeys
+    return None, new
 
 
 def parse_args(argv):

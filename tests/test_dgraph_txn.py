@@ -1,0 +1,116 @@
+"""Dgraph transaction layer: MVCC snapshots, conflict detection, the
+txn client API (reference: dgraph/client.clj:66-167)."""
+
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu.dbs import dgraph, dgraph_sim
+from jepsen_tpu.history import Op
+
+
+@pytest.fixture
+def conn(tmp_path):
+    class H(dgraph_sim.Handler):
+        store = dgraph_sim.Store(str(tmp_path / "dg.json"))
+        mean_latency = 0.0
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield dgraph.DgraphConn("127.0.0.1", srv.server_address[1])
+    srv.shutdown()
+
+
+def test_txn_commit_is_atomic_and_visible(conn):
+    with dgraph.with_txn(conn) as t:
+        t.mutate(sets=[{"key": 1, "value": 10}, {"key": 2, "value": 20}])
+    rows = conn.query("{ q(func: has(key)) { uid key value } }")
+    assert sorted(r["value"] for r in rows) == [10, 20]
+
+
+def test_txn_discard_leaves_nothing(conn):
+    t = conn.txn()
+    t.mutate(sets=[{"key": 9, "value": 9}])
+    t.discard()
+    assert conn.query("{ q(func: eq(key, 9)) { uid } }") == []
+
+
+def test_snapshot_isolation_reads_stay_at_start_ts(conn):
+    conn.mutate([{"key": 1, "value": 1}])
+    t = conn.txn()
+    # First read pins the snapshot.
+    assert t.query("{ q(func: eq(key, 1)) { value } }") == [{"value": 1}]
+    # A concurrent auto-commit write lands after our start_ts...
+    conn.mutate([{"key": 5, "value": 5}])
+    # ...and is invisible to this txn, but visible to a fresh one.
+    assert t.query("{ q(func: eq(key, 5)) { value } }") == []
+    assert conn.query("{ q(func: eq(key, 5)) { value } }") == [{"value": 5}]
+    t.commit()  # read-only: always succeeds
+
+
+def test_write_write_conflict_aborts_second_committer(conn):
+    uids = conn.mutate([{"key": 1, "value": 0}])
+    uid = list(uids.values())[0]
+    t1, t2 = conn.txn(), conn.txn()
+    t1.query("{ q(func: eq(key, 1)) { uid value } }")
+    t2.query("{ q(func: eq(key, 1)) { uid value } }")
+    t1.mutate(sets=[{"uid": uid, "value": 1}])
+    t2.mutate(sets=[{"uid": uid, "value": 2}])
+    t1.commit()
+    with pytest.raises(dgraph.TxnConflict):
+        t2.commit()
+    rows = conn.query("{ q(func: eq(key, 1)) { value } }")
+    assert rows == [{"value": 1}]
+
+
+def test_upsert_index_conflict_keys_abort_racing_inserts(conn):
+    """Two txns that both insert {key: 7} (no shared uid) conflict via
+    the (pred, value) index key — the @upsert directive's behavior."""
+    t1, t2 = conn.txn(), conn.txn()
+    t1.mutate(sets=[{"key": 7}])
+    t2.mutate(sets=[{"key": 7}])
+    t1.commit()
+    with pytest.raises(dgraph.TxnConflict):
+        t2.commit()
+    rows = conn.query("{ q(func: eq(key, 7)) { uid } }")
+    assert len(rows) == 1
+
+
+def test_delete_in_txn(conn):
+    uids = conn.mutate([{"key": 3, "value": 3}])
+    uid = list(uids.values())[0]
+    with dgraph.with_txn(conn) as t:
+        t.mutate(dels=[{"uid": uid}])
+    assert conn.query("{ q(func: eq(key, 3)) { uid } }") == []
+
+
+def test_with_conflict_as_fail_completes_op(conn):
+    op = Op(0, "invoke", "write", 5)
+
+    def body():
+        raise dgraph.TxnConflict("Transaction has been aborted.")
+
+    done = dgraph.with_conflict_as_fail(op, body)
+    assert done.type == "fail" and done.error == "conflict"
+
+
+def test_zero_state_and_move_tablet(conn):
+    conn.mutate([{"key": 1, "value": 1}])
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(conn.base + "/state") as resp:
+        state = _json.load(resp)
+    tablets = [t for g in state["groups"].values()
+               for t in g.get("tablets", {})]
+    assert set(tablets) >= {"key", "value"}
+    with urllib.request.urlopen(
+            urllib.request.Request(
+                conn.base + "/moveTablet?tablet=key&group=2",
+                method="POST", data=b"{}")) as resp:
+        assert _json.load(resp)["data"]["code"] == "Success"
+    with urllib.request.urlopen(conn.base + "/state") as resp:
+        state = _json.load(resp)
+    assert "key" in state["groups"]["2"]["tablets"]
