@@ -308,7 +308,15 @@ class UpsertChecker(Checker):
 
 
 def workloads(opts: dict) -> dict:
+    # Imported here: dgraph_workloads imports this module's txn layer.
+    from . import dgraph_workloads as dw
+
     return {
+        "bank": dw.bank_workload(opts),
+        "delete": dw.delete_workload(opts),
+        "sequential": dw.sequential_workload(opts),
+        "linearizable-register": dw.lr_workload(opts),
+        "long-fork": dw.long_fork_workload(opts),
         "set": {
             "client": SetClient(),
             "during": gen.stagger(
@@ -362,6 +370,7 @@ def dgraph_test(opts: dict) -> dict:
         )
     test = noop_test()
     test.update(opts)
+    test.update(wl.get("test_opts", {}))
     test.update(
         {
             "name": f"dgraph {opts.get('workload', 'set')}",
@@ -373,12 +382,16 @@ def dgraph_test(opts: dict) -> dict:
             "checker": wl["checker"],
         }
     )
+    if wl.get("model") is not None:
+        test["model"] = wl["model"]
     return test
 
 
 def _opt_spec(p) -> None:
     p.add_argument("--workload", default="set",
-                   choices=["set", "upsert"])
+                   choices=["set", "upsert", "bank", "delete",
+                            "sequential", "linearizable-register",
+                            "long-fork"])
     p.add_argument("--archive-url", dest="archive_url", default=None)
     p.add_argument("--tracing", default=None, metavar="SPANS_JSONL",
                    help="export client/nemesis spans to this JSONL file")

@@ -97,14 +97,19 @@ def run_query(view: dict, query: str) -> list:
     return out
 
 
-def conflict_keys(writes: dict) -> list:
-    """One key per written uid, one per written (pred, value) pair —
-    the sim's image of dgraph's uid- and index-level conflict keys."""
+def conflict_keys(touched: dict, upsert_preds: set) -> list:
+    """Conflict keys for a txn's EXPLICITLY-written triples: one per
+    touched uid, plus one per (pred, value) pair whose predicate has
+    the @upsert index directive — dgraph only materializes index-level
+    conflicts for @upsert predicates, which is what turns concurrent
+    insert-if-absent races into aborts without making every shared
+    value a false conflict."""
     keys = []
-    for uid, preds in writes.items():
+    for uid, preds in touched.items():
         keys.append(f"u:{uid}")
         for p, v in (preds or {}).items():
-            keys.append(f"pv:{p}={v!r}")
+            if p in upsert_preds:
+                keys.append(f"pv:{p}={v!r}")
     return keys
 
 
@@ -167,7 +172,7 @@ class Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError:
             return self._reply(400, {"errors": [{"message": "bad json"}]})
         if path == "/alter":
-            return self._reply(200, {"data": {"code": "Success"}})
+            return self._alter(body)
         if path == "/query":
             return self._query(body, params)
         if path == "/mutate":
@@ -183,6 +188,23 @@ class Handler(BaseHTTPRequestHandler):
     @staticmethod
     def _txn(data: dict, start_ts: int) -> dict | None:
         return (data.get("txns") or {}).get(str(start_ts))
+
+    def _alter(self, body: dict) -> None:
+        """Record which predicates carry @upsert (used for index-level
+        conflict keys); schemas merge like dgraph's alter."""
+        schema = body.get("schema") or ""
+        ups = [m.group(1)
+               for m in re.finditer(r"(\w+)\s*:[^\n.]*@upsert", schema)]
+
+        def al(data):
+            if not ups:
+                return {"data": {"code": "Success"}}, None
+            new = dict(data)
+            new["upsert_preds"] = sorted(
+                set(new.get("upsert_preds") or []) | set(ups))
+            return {"data": {"code": "Success"}}, new
+
+        self._reply(200, self.store.transact(al))
 
     def _query(self, body: dict, params: dict) -> None:
         start_ts = int(params.get("startTs") or 0)
@@ -242,8 +264,13 @@ class Handler(BaseHTTPRequestHandler):
                 ts = int(data.get("ts") or 0) + 1
                 new["ts"] = ts
             txns = dict(new.get("txns") or {})
-            txn = dict(txns.get(str(ts)) or {"writes": {}})
+            txn = dict(txns.get(str(ts)) or {"writes": {}, "touched": {}})
             writes = dict(txn["writes"])
+            # touched = only the explicitly-written (pred, value) pairs
+            # per uid — the conflict surface (merged old preds in
+            # `writes` exist for MVCC visibility, not conflicts).
+            touched = {u: dict(p) if p is not None else None
+                       for u, p in (txn.get("touched") or {}).items()}
             view = snapshot(data, ts, writes)
 
             if upsert_query is not None:
@@ -264,18 +291,22 @@ class Handler(BaseHTTPRequestHandler):
                     counter += 1
                     uid = f"0x{counter:x}"
                     uids[f"blank-{i}"] = uid
+                explicit = {k: v for k, v in triple.items() if k != "uid"}
                 merged = dict(view.get(uid) or {})
-                merged.update(
-                    {k: v for k, v in triple.items() if k != "uid"})
+                merged.update(explicit)
                 writes[uid] = merged
+                t = dict(touched.get(uid) or {})
+                t.update(explicit)
+                touched[uid] = t
             for triple in dels:
                 uid = triple.get("uid")
                 if uid is not None and uid in view:
                     writes[uid] = None
+                    touched[uid] = None
             new["uid_counter"] = counter
 
             if commit_now:
-                err, new2 = _apply_commit(new, ts, writes)
+                err, new2 = _apply_commit(new, ts, writes, touched)
                 if err:
                     return ({"_status": 409,
                              "errors": [{"message": err}]}, None)
@@ -289,6 +320,7 @@ class Handler(BaseHTTPRequestHandler):
                 return ({"data": {"code": "Success", "uids": uids},
                          "extensions": {"txn": {"start_ts": ts}}}, new2)
             txn["writes"] = writes
+            txn["touched"] = touched
             txns[str(ts)] = txn
             new["txns"] = txns
             return ({"data": {"code": "Success", "uids": uids},
@@ -312,7 +344,8 @@ class Handler(BaseHTTPRequestHandler):
                 # read-only txn has no record — see _query — and
                 # dgraph's discard of a finished txn is a no-op).
                 return ({"data": {"code": "Success"}}, new)
-            err, new2 = _apply_commit(new, start_ts, txn["writes"])
+            err, new2 = _apply_commit(new, start_ts, txn["writes"],
+                                      txn.get("touched") or txn["writes"])
             if err:
                 return ({"_status": 409,
                          "errors": [{"message": err}]}, new)
@@ -340,12 +373,15 @@ class Handler(BaseHTTPRequestHandler):
         self._reply(200, self.store.transact(mv))
 
 
-def _apply_commit(data: dict, start_ts: int, writes: dict):
-    """Conflict-check `writes` against commits after start_ts; on
-    success append new versions at a fresh commit_ts. Returns
-    (error-message-or-None, new-data)."""
+def _apply_commit(data: dict, start_ts: int, writes: dict,
+                  touched: dict):
+    """Conflict-check the txn's explicit writes against commits after
+    start_ts; on success append new versions at a fresh commit_ts.
+    Returns (error-message-or-None, new-data)."""
+    upsert_preds = set(data.get("upsert_preds") or [])
     ckeys = dict(data.get("ckeys") or {})
-    for key in conflict_keys(writes):
+    keys = conflict_keys(touched, upsert_preds)
+    for key in keys:
         if ckeys.get(key, 0) > start_ts:
             return ABORTED, None
     if not writes:
@@ -359,7 +395,7 @@ def _apply_commit(data: dict, start_ts: int, writes: dict):
         chain.append([commit_ts, preds])
         nodes[uid] = chain
     new["nodes"] = nodes
-    for key in conflict_keys(writes):
+    for key in keys:
         ckeys[key] = commit_ts
     new["ckeys"] = ckeys
     return None, new

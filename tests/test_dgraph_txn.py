@@ -67,7 +67,10 @@ def test_write_write_conflict_aborts_second_committer(conn):
 
 def test_upsert_index_conflict_keys_abort_racing_inserts(conn):
     """Two txns that both insert {key: 7} (no shared uid) conflict via
-    the (pred, value) index key — the @upsert directive's behavior."""
+    the (pred, value) index key — the @upsert directive's behavior.
+    Without @upsert in the schema, no index conflict key exists and
+    both commits succeed (duplicate records, as in real dgraph)."""
+    conn.alter("key: int @index(int) @upsert .")
     t1, t2 = conn.txn(), conn.txn()
     t1.mutate(sets=[{"key": 7}])
     t2.mutate(sets=[{"key": 7}])
@@ -76,6 +79,22 @@ def test_upsert_index_conflict_keys_abort_racing_inserts(conn):
         t2.commit()
     rows = conn.query("{ q(func: eq(key, 7)) { uid } }")
     assert len(rows) == 1
+
+
+def test_disjoint_writes_do_not_conflict(conn):
+    """Writes to different uids sharing predicate VALUES must commit:
+    only @upsert predicates get index-level conflict keys, and only for
+    explicitly-written triples (not preds merged in for visibility)."""
+    conn.alter("key: int @index(int) @upsert .")
+    u1 = list(conn.mutate([{"key": 1, "value": 3, "type": "x"}]).values())[0]
+    u2 = list(conn.mutate([{"key": 2, "value": 3, "type": "x"}]).values())[0]
+    t1, t2 = conn.txn(), conn.txn()
+    t1.mutate(sets=[{"uid": u1, "value": 9}])
+    t2.mutate(sets=[{"uid": u2, "value": 9}])  # same value, other uid
+    t1.commit()
+    t2.commit()  # must NOT abort
+    rows = conn.query("{ q(func: has(value)) { value } }")
+    assert [r["value"] for r in rows] == [9, 9]
 
 
 def test_delete_in_txn(conn):

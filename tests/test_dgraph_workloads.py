@@ -1,0 +1,222 @@
+"""Dgraph transactional workloads: bank, delete, sequential,
+linearizable-register, long-fork (reference:
+dgraph/{bank,delete,sequential,linearizable_register,long_fork}.clj)."""
+
+import os
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import core, nemesis
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import dgraph, dgraph_sim, dgraph_workloads as dw
+from jepsen_tpu.history import Op
+from jepsen_tpu import txn as mop
+
+from helpers import free_port
+
+
+@pytest.fixture
+def port(tmp_path):
+    class H(dgraph_sim.Handler):
+        store = dgraph_sim.Store(str(tmp_path / "dg.json"))
+        mean_latency = 0.0
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def _test_map(port, **extra):
+    t = {"dgraph": {"addr_fn": lambda n: "127.0.0.1",
+                    "ports": {"n1": port}}}
+    t.update(extra)
+    return t
+
+
+# -- bank -------------------------------------------------------------------
+
+
+def test_acct_row_parser():
+    assert dw._acct_row_to_key_amount(
+        {"uid": "0x1", "key_1": 1, "amount_1": 5}) == (1, 5)
+    with pytest.raises(AssertionError):
+        dw._acct_row_to_key_amount({"key_0": 1, "key_1": 2})
+
+
+def test_bank_transfer_and_read(port):
+    t = _test_map(port, accounts=[0, 1, 2], total_amount=30)
+    c = dw.BankClient().open(t, "n1")
+    c.setup(t)
+    r = c.invoke(t, Op(0, "invoke", "read", None))
+    assert r.type == "ok" and sum(r.value.values()) == 30
+    tr = c.invoke(t, Op(0, "invoke", "transfer",
+                        {"from": 0, "to": 1, "amount": 7}))
+    assert tr.type == "ok"
+    r = c.invoke(t, Op(0, "invoke", "read", None))
+    assert r.value[1] == 7 and sum(r.value.values()) == 30
+
+
+def test_bank_insufficient_funds_fails_cleanly(port):
+    t = _test_map(port, accounts=[0, 1], total_amount=10)
+    c = dw.BankClient().open(t, "n1")
+    c.setup(t)
+    tr = c.invoke(t, Op(0, "invoke", "transfer",
+                        {"from": 1, "to": 0, "amount": 5}))
+    assert tr.type == "fail" and tr.error == "insufficient-funds"
+    r = c.invoke(t, Op(0, "invoke", "read", None))
+    assert sum(r.value.values()) == 10
+
+
+def test_bank_zero_balance_account_is_deleted(port):
+    t = _test_map(port, accounts=[0, 1], total_amount=10)
+    c = dw.BankClient().open(t, "n1")
+    c.setup(t)
+    assert c.invoke(t, Op(0, "invoke", "transfer",
+                          {"from": 0, "to": 1, "amount": 10})).type == "ok"
+    r = c.invoke(t, Op(0, "invoke", "read", None))
+    # account 0 hit zero -> deleted -> absent from the read
+    assert r.value == {1: 10}
+
+
+# -- delete -----------------------------------------------------------------
+
+
+def test_delete_lifecycle(port):
+    t = _test_map(port)
+    c = dw.DeleteClient().open(t, "n1")
+    assert c.invoke(t, Op(0, "invoke", "read", (3, None))).value == (3, [])
+    assert c.invoke(t, Op(0, "invoke", "upsert", (3, None))).type == "ok"
+    up2 = c.invoke(t, Op(0, "invoke", "upsert", (3, None)))
+    assert up2.type == "fail" and up2.error == "present"
+    r = c.invoke(t, Op(0, "invoke", "read", (3, None)))
+    assert len(r.value[1]) == 1 and set(r.value[1][0]) == {"uid", "key"}
+    assert c.invoke(t, Op(0, "invoke", "delete", (3, None))).type == "ok"
+    d2 = c.invoke(t, Op(0, "invoke", "delete", (3, None)))
+    assert d2.type == "fail" and d2.error == "not-found"
+
+
+def test_delete_checker():
+    ok = [Op(0, "ok", "read", (3, [{"uid": "0x1", "key": 3}]), index=0),
+          Op(0, "ok", "read", (3, []), index=1)]
+    assert dw.DeleteChecker().check({}, ok, {"history_key": 3})["valid"]
+    bad = [Op(0, "ok", "read", (3, [{"uid": "0x1"}]), index=0)]
+    res = dw.DeleteChecker().check({}, bad, {"history_key": 3})
+    assert res["valid"] is False and len(res["bad_reads"]) == 1
+    two = [Op(0, "ok", "read",
+              (3, [{"uid": "0x1", "key": 3}, {"uid": "0x2", "key": 3}]),
+              index=0)]
+    assert not dw.DeleteChecker().check({}, two, {})["valid"]
+
+
+# -- sequential -------------------------------------------------------------
+
+
+def test_sequential_inc_and_read(port):
+    t = _test_map(port)
+    c = dw.SequentialClient().open(t, "n1")
+    assert c.invoke(t, Op(0, "invoke", "read", (1, None))).value == (1, 0)
+    assert c.invoke(t, Op(0, "invoke", "inc", (1, None))).value == (1, 1)
+    assert c.invoke(t, Op(0, "invoke", "inc", (1, None))).value == (1, 2)
+    assert c.invoke(t, Op(0, "invoke", "read", (1, None))).value == (1, 2)
+
+
+def test_sequential_checker_catches_regression():
+    good = [Op(0, "ok", "read", (1, 1), index=0),
+            Op(0, "ok", "read", (1, 2), index=1),
+            Op(1, "ok", "read", (1, 1), index=2)]
+    assert dw.SequentialChecker().check({}, good, {})["valid"]
+    bad = good + [Op(0, "ok", "read", (1, 1), index=3)]
+    res = dw.SequentialChecker().check({}, bad, {})
+    assert res["valid"] is False and len(res["non_monotonic"]) == 1
+
+
+# -- linearizable register --------------------------------------------------
+
+
+def test_lr_client_read_write_cas(port):
+    t = _test_map(port)
+    c = dw.LrClient().open(t, "n1")
+    assert c.invoke(t, Op(0, "invoke", "read", (5, None))).value == (5, None)
+    assert c.invoke(t, Op(0, "invoke", "write", (5, 3))).type == "ok"
+    assert c.invoke(t, Op(0, "invoke", "read", (5, None))).value == (5, 3)
+    miss = c.invoke(t, Op(0, "invoke", "cas", (5, (9, 4))))
+    assert miss.type == "fail" and miss.error == "value-mismatch"
+    assert c.invoke(t, Op(0, "invoke", "cas", (5, (3, 4)))).type == "ok"
+    assert c.invoke(t, Op(0, "invoke", "read", (5, None))).value == (5, 4)
+
+
+# -- long fork --------------------------------------------------------------
+
+
+def test_long_fork_client(port):
+    t = _test_map(port)
+    c = dw.LongForkClient().open(t, "n1")
+    w = c.invoke(t, Op(0, "invoke", "write", [[mop.WRITE, 0, 1]]))
+    assert w.type == "ok"
+    r = c.invoke(t, Op(0, "invoke", "read",
+                       [[mop.READ, 0, None], [mop.READ, 1, None]]))
+    assert r.type == "ok"
+    assert r.value == [[mop.READ, 0, 1], [mop.READ, 1, None]]
+
+
+# -- full runs through the engine ------------------------------------------
+
+
+def _full_run(tmp_path, workload, **opts):
+    nodes = ["n1", "n2"]
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "dg.tar.gz")
+    dgraph_sim.build_archive(archive, str(tmp_path / "s" / "d.json"))
+    o = {
+        "workload": workload,
+        "nodes": nodes,
+        "remote": remote,
+        "archive_url": f"file://{archive}",
+        "dgraph": {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        },
+        "concurrency": 4,
+        "time_limit": 3,
+        "quiesce": 0.2,
+        "store_dir": str(tmp_path / "store"),
+    }
+    o.update(opts)
+    t = dgraph.dgraph_test(o)
+    t["os"] = None
+    t["net"] = None
+    t["nemesis"] = nemesis.noop
+    return core.run(t)
+
+
+def test_full_run_bank(tmp_path):
+    result = _full_run(tmp_path, "bank")
+    assert result["results"]["valid"] is True, result["results"]
+    assert result["results"]["bank"]["valid"] is True
+
+
+def test_full_run_sequential(tmp_path):
+    result = _full_run(tmp_path, "sequential", ops_per_key=30)
+    assert result["results"]["valid"] is True, result["results"]
+
+
+def test_full_run_delete(tmp_path):
+    result = _full_run(tmp_path, "delete", ops_per_key=30)
+    assert result["results"]["valid"] is True, result["results"]
+
+
+def test_full_run_linearizable_register(tmp_path):
+    result = _full_run(tmp_path, "linearizable-register",
+                       per_key_limit=40)
+    assert result["results"]["valid"] is True, result["results"]
+
+
+def test_full_run_long_fork(tmp_path):
+    result = _full_run(tmp_path, "long-fork")
+    assert result["results"]["valid"] is True, result["results"]
