@@ -353,18 +353,31 @@ def workloads(opts: dict) -> dict:
 def dgraph_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    from . import dgraph_nemesis
+
     # Configure span tracing (dgraph/core.clj wires trace/tracing from
     # the CLI's --tracing endpoint; here the endpoint is a JSONL path).
     trace.tracing(opts.get("tracing"))
     wl = workloads(opts)[opts.get("workload", "set")]
+    db = DgraphDB(archive_url=opts.get("archive_url"))
+    # Failure-mode flags select the full composed nemesis
+    # (dgraph/nemesis.clj:122-180); default is partition halves.
+    pkg = dgraph_nemesis.package(db, opts)
+    if pkg is None:
+        pkg = {"nemesis": nemesis.partition_random_halves(),
+               "generator": gen.start_stop(10, 10),
+               "final_generator": gen.once(
+                   {"type": "info", "f": "stop"})}
     generator = gen.time_limit(
         opts.get("time_limit", 60),
-        gen.nemesis(gen.start_stop(10, 10), wl["during"]),
+        gen.nemesis(pkg["generator"], wl["during"]),
     )
     if wl.get("final") is not None:
+        heal = ([gen.nemesis(pkg["final_generator"])]
+                if pkg.get("final_generator") is not None else [])
         generator = gen.phases(
             generator,
-            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            *heal,
             gen.sleep(opts.get("quiesce", 10)),
             wl["final"],
         )
@@ -375,9 +388,9 @@ def dgraph_test(opts: dict) -> dict:
         {
             "name": f"dgraph {opts.get('workload', 'set')}",
             "os": osdist.debian,
-            "db": DgraphDB(archive_url=opts.get("archive_url")),
+            "db": db,
             "client": wl["client"],
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": pkg["nemesis"],
             "generator": generator,
             "checker": wl["checker"],
         }
@@ -395,6 +408,16 @@ def _opt_spec(p) -> None:
     p.add_argument("--archive-url", dest="archive_url", default=None)
     p.add_argument("--tracing", default=None, metavar="SPANS_JSONL",
                    help="export client/nemesis spans to this JSONL file")
+    # Failure-mode flags (dgraph/core.clj's nemesis options)
+    for flag in ("kill-alpha", "kill-zero", "fix-alpha",
+                 "partition-halves", "partition-ring", "skew-clock",
+                 "move-tablet"):
+        p.add_argument(f"--{flag}", dest=flag.replace("-", "_"),
+                       action="store_true")
+    p.add_argument("--skew", default=None,
+                   choices=["tiny", "small", "big", "huge"])
+    p.add_argument("--interval", type=float, default=10.0,
+                   help="seconds between nemesis operations")
 
 
 def main(argv=None) -> None:

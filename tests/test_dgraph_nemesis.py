@@ -1,0 +1,135 @@
+"""Dgraph composed nemesis (reference: dgraph/nemesis.clj)."""
+
+import os
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import core, generator as gen
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import dgraph, dgraph_nemesis as dn, dgraph_sim
+from jepsen_tpu.history import Op
+
+from helpers import free_port
+
+
+def _drain(g, test, process, n=20):
+    out = []
+    for _ in range(n):
+        op = gen.op(g, test, process)
+        if op is None:
+            break
+        out.append(op)
+    return out
+
+
+def test_full_generator_respects_flags():
+    g = dn.full_generator({"kill_alpha": True, "interval": 0})
+    fs = [o["f"] for o in _drain(g, {"nodes": ["n1"]}, "nemesis", 4)]
+    assert fs == ["kill-alpha", "restart-alpha",
+                  "kill-alpha", "restart-alpha"]
+    assert dn.full_generator({}) is None
+
+
+def test_final_generator_heals_in_reference_order():
+    g = dn.final_generator({"kill_alpha": True, "partition_ring": True,
+                            "skew_clock": True, "final_delay": 0})
+    fs = [o["f"] for o in _drain(g, {"nodes": ["n1"]}, "nemesis")]
+    assert fs == ["stop-partition-ring", "stop-skew", "restart-alpha"]
+    assert dn.final_generator({}) is None
+
+
+def test_skew_magnitudes():
+    assert dn.skew({"skew": "huge"}).dt_ms == 7500
+    assert dn.skew({"skew": "tiny"}).dt_ms == 100
+    assert dn.skew({}).dt_ms == 0
+
+
+@pytest.fixture
+def sim_port(tmp_path):
+    class H(dgraph_sim.Handler):
+        store = dgraph_sim.Store(str(tmp_path / "dg.json"))
+        mean_latency = 0.0
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_tablet_mover_moves_every_tablet_off_its_group(sim_port):
+    conn = dgraph.DgraphConn("127.0.0.1", sim_port)
+    conn.mutate([{"key": 1, "value": 2, "other": 3}])
+    test = {"nodes": ["n1"],
+            "dgraph": {"addr_fn": lambda n: "127.0.0.1",
+                       "ports": {"n1": sim_port}}}
+    mover = dn.TabletMover(dgraph._suite)
+    done = mover.invoke(test, Op("nemesis", "info", "move-tablet", None))
+    assert done.type == "info"
+    # Every moved pred records [from, to] with from != to
+    assert done.value, "nothing moved"
+    for pred, mv in done.value.items():
+        assert mv[0] != mv[1], (pred, mv)
+    state = mover._get_state(test, "n1")
+    for pred, mv in done.value.items():
+        assert pred in state["groups"][mv[1]]["tablets"]
+
+
+def _full_run(tmp_path, **flags):
+    nodes = ["n1", "n2"]
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "dg.tar.gz")
+    dgraph_sim.build_archive(archive, str(tmp_path / "s" / "d.json"))
+    opts = {
+        "workload": "set",
+        "nodes": nodes,
+        "remote": remote,
+        "archive_url": f"file://{archive}",
+        "dgraph": {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        },
+        "interval": 1.0,
+        "final_delay": 0.3,
+        "concurrency": 4,
+        "time_limit": 4,
+        # A killed sim daemon takes ~2s to re-bind on a 1-core box
+        # (longer under load); quiesce must comfortably outlast the
+        # restart.
+        "quiesce": 5.0,
+        "stagger": 0.02,
+        "store_dir": str(tmp_path / "store"),
+    }
+    opts.update(flags)
+    t = dgraph.dgraph_test(opts)
+    t["os"] = None
+    t["net"] = None  # partitions not exercised hermetically
+    result = core.run(t)
+    nem_fs = {o.f for o in
+              (Op.from_dict(d) if isinstance(d, dict) else d
+               for d in result["history"])
+              if o.process == "nemesis"}
+    return result, nem_fs
+
+
+def test_full_run_with_kill_nemesis(tmp_path):
+    """End-to-end: the set workload under a deterministic
+    kill-alpha/restart-alpha cycle, healed by the final generator
+    before the final read. Only one mode is enabled so the cycle is
+    guaranteed to fire (gen.mix would make a multi-mode history
+    non-deterministic)."""
+    result, nem_fs = _full_run(tmp_path, kill_alpha=True)
+    assert result["results"]["valid"] is True, result["results"]
+    assert "kill-alpha" in nem_fs and "restart-alpha" in nem_fs
+
+
+def test_full_run_with_tablet_mover(tmp_path):
+    """End-to-end: move-tablet never kills daemons, so the run is
+    deterministic and must come out valid with moves journaled."""
+    result, nem_fs = _full_run(tmp_path, move_tablet=True)
+    assert result["results"]["valid"] is True, result["results"]
+    assert "move-tablet" in nem_fs
