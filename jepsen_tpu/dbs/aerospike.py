@@ -20,7 +20,8 @@ import random
 import socket
 
 from .. import checker as checker_mod
-from .. import cli, client, generator as gen, models, nemesis, osdist
+from .. import cli, client, generator as gen, independent, models, \
+    nemesis, osdist
 from ..control import util as cu
 from ..history import Op
 from . import aerospike_proto as ap
@@ -168,6 +169,48 @@ class CounterClient(client.Client):
             self.conn.close()
 
 
+class SetClient(client.Client):
+    """A set as CAS-free string appends on a single bin per key
+    (aerospike/set.clj:11-45): add appends " v", read splits the bin
+    into a sorted set of ints. Values are independent (k, v) tuples."""
+
+    def __init__(self, conn=None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return SetClient(
+            ap.AerospikeConn(node_host(test, node),
+                             node_port(test, node)))
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "read":
+                _gen, bins = self.conn.get(("set", k))
+                raw = (bins or {}).get("value") or ""
+                vals = sorted(int(x) for x in raw.split() if x)
+                return op.with_(type="ok",
+                                value=independent.tuple_(k, vals))
+            if op.f == "add":
+                self.conn.append(("set", k), {"value": f" {v}"})
+                return op.with_(type="ok")
+            raise ValueError(f"unknown op {op.f!r}")
+        except ap.AerospikeError as e:
+            crash = "fail" if op.f == "read" else "info"
+            return op.with_(type=crash, error=f"code-{e.code}")
+        except (socket.timeout, TimeoutError):
+            return op.with_(
+                type="fail" if op.f == "read" else "info",
+                error="timeout")
+        except (ConnectionError, OSError) as e:
+            return op.with_(
+                type="fail" if op.f == "read" else "info", error=str(e))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
 class KillNemesis(ArchiveKillNemesis):
     """The reference's bounded-dead-set killer
     (aerospike/src/aerospike/nemesis.clj:17-58): the generic ArchiveDB
@@ -227,6 +270,57 @@ def workloads(opts: dict) -> dict:
                 "counter": checker_mod.counter(),
             }),
         },
+        "set": _set_workload(opts),
+    }
+
+
+def _set_workload(opts: dict) -> dict:
+    """CAS-free appends across independent keys, 5 clients per key,
+    with a deferred final read of every key that was touched
+    (aerospike/set.clj:47-71 — the max-key atom + derefer dance)."""
+    import itertools
+    import threading
+
+    seen_keys: list = []
+    lock = threading.Lock()
+
+    def fgen(k):
+        with lock:
+            seen_keys.append(k)
+        ctr = itertools.count()  # per-key, captured by the closure
+
+        def add_op(test, process):
+            return {"type": "invoke", "f": "add", "value": next(ctr)}
+
+        return gen.limit(opts.get("ops_per_key", 200),
+                         gen.stagger(opts.get("stagger", 0.05), add_op))
+
+    # derefer calls its thunk per op request; the reference wraps the
+    # final generator in a delay (set.clj:62-71) so it's built ONCE at
+    # first deref — memoize or every request builds a fresh generator
+    # and the final phase never exhausts.
+    final_cache: list = []
+
+    def final():
+        with lock:
+            if not final_cache:
+                ks = sorted(seen_keys)
+                final_cache.append(independent.concurrent_generator(
+                    5, ks,
+                    lambda k: gen.each(
+                        lambda: gen.once(
+                            {"type": "invoke", "f": "read"}))))
+            return final_cache[0]
+
+    return {
+        "client": SetClient(),
+        "during": independent.concurrent_generator(
+            5, itertools.count(), fgen),
+        "final": gen.derefer(final),
+        "checker": checker_mod.compose({
+            "perf": checker_mod.perf_checker(),
+            "sets": independent.checker(checker_mod.set_checker()),
+        }),
     }
 
 
@@ -234,6 +328,17 @@ def aerospike_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
     wl = workloads(opts)[opts.get("workload", "cas-register")]
+    generator = gen.time_limit(
+        opts.get("time_limit", 60),
+        gen.nemesis(gen.start_stop(10, 10), wl["during"]),
+    )
+    if wl.get("final") is not None:
+        generator = gen.phases(
+            generator,
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(opts.get("quiesce", 10)),
+            gen.clients(wl["final"]),
+        )
     test = noop_test()
     test.update(opts)
     test.update(
@@ -244,10 +349,7 @@ def aerospike_test(opts: dict) -> dict:
             "client": wl["client"],
             "nemesis": nemesis.partition_random_halves(),
             "model": wl.get("model"),
-            "generator": gen.time_limit(
-                opts.get("time_limit", 60),
-                gen.nemesis(gen.start_stop(10, 10), wl["during"]),
-            ),
+            "generator": generator,
             "checker": wl["checker"],
         }
     )
@@ -256,7 +358,7 @@ def aerospike_test(opts: dict) -> dict:
 
 def _opt_spec(p) -> None:
     p.add_argument("--workload", default="cas-register",
-                   choices=["cas-register", "counter"])
+                   choices=["cas-register", "counter", "set"])
     p.add_argument("--archive-url", dest="archive_url", default=None)
 
 

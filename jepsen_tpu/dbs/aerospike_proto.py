@@ -36,6 +36,7 @@ FIELD_DIGEST = 4
 
 OP_READ = 1
 OP_WRITE = 2
+OP_APPEND = 9
 
 BIN_TYPE_INTEGER = 1
 BIN_TYPE_STRING = 3
@@ -174,6 +175,16 @@ class AerospikeConn:
             generation = expected_generation
         ops = [_op(OP_WRITE, name, v) for name, v in bins.items()]
         msg = build_message(0, info2, generation,
+                            self._key_fields(key), ops)
+        result, _gen, _bins = self._roundtrip(msg)
+        if result != RESULT_OK:
+            raise AerospikeError(result)
+
+    def append(self, key, bins: dict) -> None:
+        """Append to string bins (the set workload's primitive:
+        aerospike/set.clj:35 appends \" v\" to one bin with s/append!)."""
+        ops = [_op(OP_APPEND, name, v) for name, v in bins.items()]
+        msg = build_message(0, INFO2_WRITE, 0,
                             self._key_fields(key), ops)
         result, _gen, _bins = self._roundtrip(msg)
         if result != RESULT_OK:

@@ -109,6 +109,10 @@ class Handler(socketserver.BaseRequestHandler):
                 for op_type, btype, name, value in ops:
                     if op_type == ap.OP_WRITE:
                         new_bins[name] = (btype, value.hex())
+                    elif op_type == ap.OP_APPEND:
+                        old = new_bins.get(name)
+                        prior = bytes.fromhex(old[1]) if old else b""
+                        new_bins[name] = (btype, (prior + value).hex())
                 records[digest] = {
                     "generation": (rec["generation"] + 1) if rec else 1,
                     "bins": new_bins,

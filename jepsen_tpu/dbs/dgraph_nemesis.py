@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import random
+import urllib.parse
 import urllib.request
 
 from .. import generator as gen, nemesis, trace, util
@@ -95,9 +96,9 @@ class TabletMover(Nemesis):
             return json.load(resp)
 
     def _move(self, test, node, pred: str, group: str) -> None:
+        q = urllib.parse.urlencode({"tablet": pred, "group": group})
         url = (f"http://{self.suite.host(test, node)}:"
-               f"{self.suite.port(test, node)}/moveTablet"
-               f"?tablet={pred}&group={group}")
+               f"{self.suite.port(test, node)}/moveTablet?{q}")
         req = urllib.request.Request(url, method="POST", data=b"{}")
         with urllib.request.urlopen(req, timeout=5) as resp:
             resp.read()
@@ -139,16 +140,10 @@ class BumpTimeSkew(Nemesis):
         self.dt_ms = dt_ms
 
     def setup(self, test):
-        # Same bring-up as ClockNemesis (nemesis/time.py): compile and
-        # install the native bump-time tool, stop ntpd so it can't
-        # fight the skew, then best-effort reset — without the install
-        # the first :start would crash on a missing /opt binary.
-        remote = test["remote"]
-        for node in test["nodes"]:
-            nt.install(remote, node)
-            remote.exec(node, ["service", "ntpd", "stop"],
-                        sudo=True, check=False)
-            nt.ClockNemesis._try_reset(remote, node)
+        # Shared clock bring-up (install native bump-time tool, stop
+        # ntpd, best-effort reset) — without the install the first
+        # :start would crash on a missing /opt binary.
+        nt.bring_up(test)
         return self
 
     def invoke(self, test, op: Op) -> Op:
@@ -166,13 +161,13 @@ class BumpTimeSkew(Nemesis):
                                            util.real_pmap(bump, nodes))))
         if op.f == "stop":
             for node in test["nodes"]:
-                nt.ClockNemesis._try_reset(remote, node)
+                nt.try_reset(remote, node)
             return op.with_(type="info", value="reset")
         raise ValueError(f"bump-time can't handle {op.f!r}")
 
     def teardown(self, test):
         for node in test["nodes"]:
-            nt.ClockNemesis._try_reset(test["remote"], node)
+            nt.try_reset(test["remote"], node)
 
 
 SKEWS = {"huge": 7500, "big": 2000, "small": 250, "tiny": 100}

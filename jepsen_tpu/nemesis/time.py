@@ -114,6 +114,29 @@ def strobe_time(remote: Remote, node, delta_ms, period_ms, duration_s,
     )
 
 
+def try_reset(remote, node) -> None:
+    """Best-effort clock reset — hosts without ntpdate/network just
+    log (nemesis/time.clj:89-96's guarded reset)."""
+    try:
+        reset_time(remote, node)
+    except RemoteError:
+        log.warning("ntpdate reset failed on %s", node)
+
+
+def bring_up(test, opt_dir: str = OPT_DIR) -> None:
+    """Shared clock-nemesis bring-up: install the native bump/strobe
+    tools on every node in parallel, stop ntpd so it can't fight the
+    skew, and best-effort reset (nemesis/time.clj:89-99)."""
+    remote = test["remote"]
+    on_nodes(test, lambda t, n: install(remote, n, opt_dir))
+    on_nodes(
+        test,
+        lambda t, n: remote.exec(n, ["service", "ntpd", "stop"],
+                                 sudo=True, check=False),
+    )
+    on_nodes(test, lambda t, n: try_reset(remote, n))
+
+
 class ClockNemesis(Nemesis):
     """Clock manipulation nemesis (nemesis/time.clj:89-135)."""
 
@@ -121,23 +144,11 @@ class ClockNemesis(Nemesis):
         self.opt_dir = opt_dir
 
     def setup(self, test):
-        remote = test["remote"]
-        on_nodes(test, lambda t, n: install(remote, n, self.opt_dir))
-        # Stop ntpd if present so it can't fight our skew
-        on_nodes(
-            test,
-            lambda t, n: remote.exec(n, ["service", "ntpd", "stop"],
-                                     sudo=True, check=False),
-        )
-        on_nodes(test, lambda t, n: self._try_reset(remote, n))
+        bring_up(test, self.opt_dir)
         return self
 
-    @staticmethod
-    def _try_reset(remote, node):
-        try:
-            reset_time(remote, node)
-        except RemoteError:
-            log.warning("ntpdate reset failed on %s", node)
+    # kept for callers that used the private name
+    _try_reset = staticmethod(try_reset)
 
     def invoke(self, test, op):
         remote = test["remote"]

@@ -64,6 +64,13 @@ class TestAerospikeWire:
         assert c.get("s")[1] == {"name": "hello"}
         c.close()
 
+    def test_append(self, as_port):
+        c = ap.AerospikeConn("127.0.0.1", as_port)
+        c.append("a", {"value": " 1"})
+        c.append("a", {"value": " 2"})
+        assert c.get("a")[1] == {"value": " 1 2"}
+        c.close()
+
 
 class TestAerospikeClients:
     def _map(self, port):
@@ -85,6 +92,47 @@ class TestAerospikeClients:
         for _ in range(5):
             assert c.invoke(t, Op(0, "invoke", "add", 1)).type == "ok"
         assert c.invoke(t, Op(0, "invoke", "read", None)).value == 5
+
+    def test_set_client(self, as_port):
+        t = self._map(as_port)
+        c = aerospike.SetClient().open(t, "n1")
+        for v in (3, 1, 2):
+            assert c.invoke(t, Op(0, "invoke", "add", (7, v))).type == "ok"
+        r = c.invoke(t, Op(0, "invoke", "read", (7, None)))
+        assert r.type == "ok" and r.value == (7, [1, 2, 3])
+        # other keys are independent
+        r9 = c.invoke(t, Op(0, "invoke", "read", (9, None)))
+        assert r9.value == (9, [])
+
+    def test_full_run_set(self, tmp_path):
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "as.tar.gz")
+        aerospike_sim.build_archive(archive, str(tmp_path / "s" / "a.json"))
+        t = aerospike.aerospike_test({
+            "workload": "set",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "aerospike": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 5,
+            "time_limit": 3,
+            "quiesce": 0.5,
+            "stagger": 0.01,
+            "ops_per_key": 40,
+            "store_dir": str(tmp_path / "store"),
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+        assert result["results"]["sets"]["valid"] is True
 
     def test_full_run(self, tmp_path):
         nodes = ["n1", "n2"]
