@@ -114,6 +114,11 @@ class JitModel:
     def vec_unstep(self, state, f, v1, v2):
         raise NotImplementedError(f"{self.name} has no inverse step")
 
+    def vec_canon(self, state):
+        """State vector as it enters the memo key — identity for models
+        whose vector IS the logical state."""
+        return state
+
 
 def _cas_register_step(state, f, v1, v2):
     # f: 0=read 1=write 2=cas (REGISTER_SCHEMA order); f == -1
@@ -250,20 +255,97 @@ class QueueJitModel:
         delta = jnp.where(f == 0, -1, 1)
         return state.at[slot].add(delta.astype(jnp.int32))
 
-
 unordered_queue = QueueJitModel()
 
 
+@dataclass(frozen=True)
+class FifoQueueJitModel(QueueJitModel):
+    """knossos.model/fifo-queue as a ring-buffer kernel model. Shares
+    the per-lane value-universe codec and encoding machinery with
+    QueueJitModel; only state layout and transitions differ.
+
+    State is int32[W+2]: W buffer slots holding encoded value ids in
+    enqueue order, then head and tail cursors. W = the lane's enqueue
+    count, the most values that can ever be pending at once. Enqueue
+    writes buf[tail], tail+=1; dequeue is ok iff head<tail and
+    buf[head] == v, head+=1 (the value stays in place).
+
+    Order matters, so the memo key includes the state — canonicalized
+    by vec_canon so representationally different vectors with the same
+    logical queue share a key. Both transitions are exactly invertible
+    (cursor decrements; dequeue never clears its slot, and enqueues
+    only ever write at tail >= head so a popped dequeue's value is
+    still in buf[head-1]), so has_unstep=True and the kernel skips the
+    per-depth state-snapshot stack."""
+
+    name: str = "fifo-queue"
+
+    state_in_key = True
+    has_unstep = True
+
+    def lane_width(self, es) -> int:
+        n_enq = sum(1 for f in es.f if f == "enqueue")
+        return max(1, n_enq) + 2
+
+    def vec_step(self, state, f, v1, v2):
+        w = state.shape[0] - 2
+        head, tail = state[w], state[w + 1]
+        is_enq = f == 0
+        is_deq = f == 1
+        front = state[jnp.clip(head, 0, w - 1)]
+        enq_ok = is_enq & (tail < w)
+        deq_ok = is_deq & (head < tail) & (front == v1)
+        ok = enq_ok | deq_ok
+        slot = jnp.clip(tail, 0, w - 1)
+        state = state.at[slot].set(
+            jnp.where(enq_ok, v1, state[slot]).astype(jnp.int32))
+        state = state.at[w].set(
+            (head + jnp.where(deq_ok, 1, 0)).astype(jnp.int32))
+        state = state.at[w + 1].set(
+            (tail + jnp.where(enq_ok, 1, 0)).astype(jnp.int32))
+        return state, ok
+
+    def vec_unstep(self, state, f, v1, v2):
+        # exact inverse of an APPLIED (ok) transition
+        w = state.shape[0] - 2
+        delta_enq = jnp.where(f == 0, 1, 0)
+        delta_deq = jnp.where(f == 1, 1, 0)
+        state = state.at[w].set(
+            (state[w] - delta_deq).astype(jnp.int32))
+        state = state.at[w + 1].set(
+            (state[w + 1] - delta_enq).astype(jnp.int32))
+        return state
+
+    def vec_canon(self, state):
+        """Memo keys must encode the LOGICAL queue — (head, tail)
+        offsets and dead slots are representation. Shift the live
+        window to offset 0 and zero everything else, so memo behavior
+        (and step counts) matches the host search exactly."""
+        w = state.shape[0] - 2
+        head, tail = state[w], state[w + 1]
+        count = tail - head
+        rolled = jnp.roll(state[:w], -head)
+        live = jnp.arange(w) < count
+        buf = jnp.where(live, rolled, 0).astype(jnp.int32)
+        out = jnp.concatenate(
+            [buf, jnp.stack([count, jnp.zeros_like(count)])])
+        return out.astype(jnp.int32)
+
+
+fifo_queue = FifoQueueJitModel()
+
+
 BY_NAME = {
-    m.name: m for m in (cas_register, register, mutex, unordered_queue)
+    m.name: m
+    for m in (cas_register, register, mutex, unordered_queue, fifo_queue)
 }
 
 
 def for_model(model):
     """The kernel-model equivalent of a host model instance (fresh state
-    only), or None if the model has no kernel encoding (FIFO queues,
-    sets) — the checker then uses the host search path."""
-    from . import CASRegister, Mutex, Register, UnorderedQueue
+    only), or None if the model has no kernel encoding (sets) — the
+    checker then uses the host search path."""
+    from . import CASRegister, FIFOQueue, Mutex, Register, UnorderedQueue
 
     if isinstance(model, CASRegister) and model.value is None:
         return cas_register
@@ -273,6 +355,8 @@ def for_model(model):
         return mutex
     if isinstance(model, UnorderedQueue) and not model.pending:
         return unordered_queue
+    if isinstance(model, FIFOQueue) and not model.items:
+        return fifo_queue
     return None
 
 

@@ -86,21 +86,63 @@ struct Stepper {
         }
         return false;
       }
+      case 4: {  // fifo-queue ring buffer: [buf(width-2), head, tail]
+        const int w = width - 2;
+        int32_t& head = state[w];
+        int32_t& tail = state[w + 1];
+        if (f == 0) {  // enqueue v1 at the tail
+          if (tail >= w || v1 < 0) return false;
+          state[tail] = v1;
+          tail += 1;
+          return true;
+        }
+        if (f == 1 && head < tail && state[head] == v1) {
+          head += 1;  // value stays in place (needed by unstep)
+          return true;
+        }
+        return false;
+      }
       default:
         return false;
     }
   }
 
   void unstep(std::vector<int32_t>& state, int32_t f, int32_t v1) const {
-    // queue only (has_unstep): exact inverse of an APPLIED transition
+    // has_unstep kinds only: exact inverse of an APPLIED transition
+    if (kind == 3) {
+      if (f == 0)
+        state[v1] -= 1;
+      else
+        state[v1] += 1;
+      return;
+    }
+    // fifo-queue: enqueue pops the tail, dequeue restores the head —
+    // buf[head-1] still holds the dequeued value (never overwritten,
+    // enqueues only write at tail >= head)
+    const int w = width - 2;
     if (f == 0)
-      state[v1] -= 1;
+      state[w + 1] -= 1;
     else
-      state[v1] += 1;
+      state[w] -= 1;
   }
 
   bool state_in_key() const { return kind != 3; }
-  bool has_unstep() const { return kind == 3; }
+  bool has_unstep() const { return kind == 3 || kind == 4; }
+
+  // Memo keys must encode the LOGICAL state: the fifo ring buffer's
+  // (head, tail) offsets and dead slots are representation, not state
+  // — canonicalize to [live values at 0.., count, 0] so memo behavior
+  // (and hence step counts) exactly matches the host search, which
+  // memoizes on the model's items tuple.
+  std::vector<int32_t> canon(const std::vector<int32_t>& state) const {
+    if (kind != 4) return state;
+    const int w = width - 2;
+    const int32_t head = state[w], tail = state[w + 1];
+    std::vector<int32_t> out(width, 0);
+    for (int32_t i = head; i < tail; ++i) out[i - head] = state[i];
+    out[w] = tail - head;
+    return out;
+  }
 };
 
 std::string make_key(const std::vector<uint64_t>& bits,
@@ -146,8 +188,11 @@ long long wgl_search(int n, const int32_t* f, const int32_t* v1,
 
   Stepper stepper{model_kind, state_width};
   std::vector<int32_t> state(state_width, 0);
-  state[0] = (model_kind == 3) ? 0 : init_state;
-  if (model_kind == 3) std::fill(state.begin(), state.end(), 0);
+  if (model_kind == 3 || model_kind == 4) {
+    std::fill(state.begin(), state.end(), 0);
+  } else {
+    state[0] = init_state;
+  }
 
   // Event linked list: node id = event position + 1; 0 is the head
   // sentinel (and the off-the-end target).
@@ -198,7 +243,11 @@ long long wgl_search(int n, const int32_t* f, const int32_t* v1,
   stack.reserve(n);
 
   std::unordered_set<std::string> cache;
-  cache.insert(make_key(lin, state, stepper.state_in_key()));
+  // canon() copies; only the fifo kind needs canonicalization, every
+  // other kind keeps the zero-copy path
+  cache.insert(stepper.kind == 4
+                   ? make_key(lin, stepper.canon(state), true)
+                   : make_key(lin, state, stepper.state_in_key()));
 
   int completed_done = 0;
   int best_depth = -1;
@@ -239,7 +288,10 @@ long long wgl_search(int n, const int32_t* f, const int32_t* v1,
       bool ok = stepper.step(state, f[e], v1[e], v2[e]);
       if (ok) {
         lin[e >> 6] |= (1ull << (e & 63));
-        std::string key = make_key(lin, state, stepper.state_in_key());
+        std::string key =
+            stepper.kind == 4
+                ? make_key(lin, stepper.canon(state), true)
+                : make_key(lin, state, stepper.state_in_key());
         if (cache.insert(std::move(key)).second) {
           stack.push_back({e, prev_scalar});
           if (!crashed[e]) ++completed_done;

@@ -35,6 +35,7 @@ _MODEL_KINDS = {
     "register": 1,
     "mutex": 2,
     "unordered-queue": 3,
+    "fifo-queue": 4,
 }
 
 _lock = threading.Lock()

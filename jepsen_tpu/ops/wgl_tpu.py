@@ -196,11 +196,15 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
         new_lin = lin.at[word].set(lin[word] | bit)
 
         # ---- cache probe (exact full-key compare) ----
+        # canonicalized state: memo keys encode LOGICAL state (e.g. the
+        # fifo ring buffer's live window, not its offsets)
+        key_state = jm.vec_canon(new_state) if jm.state_in_key \
+            else new_state
         key_parts = [new_lin.astype(jnp.int32)]
         if jm.state_in_key:
-            key_parts.append(new_state)
+            key_parts.append(key_state)
         key = jnp.concatenate(key_parts)
-        h = _hash_key(new_lin, new_state, jm.state_in_key)
+        h = _hash_key(new_lin, key_state, jm.state_in_key)
         probe_idx = (h[None] + jnp.arange(N_PROBES, dtype=jnp.uint32)) & mask
         probe_idx = probe_idx.astype(jnp.int32)
         slot_keys = st["cache_keys"][probe_idx]          # [P, key_width]

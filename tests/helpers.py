@@ -114,13 +114,16 @@ def random_queue_history(
     n_values=None,
     corrupt=0.0,
     seed=0,
+    fifo=False,
 ):
     """A random concurrent unordered-queue history produced by simulating
     a real (atomic) queue with linearization points at invocation —
     linearizable by construction unless `corrupt` > 0, in which case some
     dequeue results are randomized (possibly to values never enqueued).
     n_values=None gives mostly-unique payloads; a small n_values forces
-    duplicate enqueues, exercising multiset count semantics."""
+    duplicate enqueues, exercising multiset count semantics. fifo=True
+    dequeues strictly from the front (for the fifo-queue model — note a
+    FIFO-run history is also unordered-queue-valid, not vice versa)."""
     from jepsen_tpu.history import Op
 
     rng = random.Random(seed)
@@ -156,7 +159,7 @@ def random_queue_history(
                     history.append(Op(p, "fail", f, None, time=t))
                     t += 1
                     continue
-                result = q.pop(rng.randrange(len(q)))  # unordered
+                result = q.pop(0 if fifo else rng.randrange(len(q)))
                 value = None  # dequeue invoke doesn't know its value yet
                 if corrupt and rng.random() < corrupt:
                     result = rng.randrange(2 * n_values)

@@ -43,6 +43,16 @@ class TestParity:
         assert a.valid == b.valid
         assert a.steps == b.steps
 
+    @pytest.mark.parametrize("corrupt", [0.0, 0.3])
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fifo_queue_matches_host(self, seed, corrupt):
+        h = random_queue_history(n_process=4, n_ops=50,
+                                 corrupt=corrupt, seed=seed, fifo=True)
+        a = wgl_host.analysis(models.FIFOQueue(), h)
+        b = wgl_native.analysis(models.FIFOQueue(), h)
+        assert a.valid == b.valid
+        assert a.steps == b.steps
+
     def test_register_model(self):
         h = [
             Op(0, "invoke", "write", 1, time=0, index=0),

@@ -15,7 +15,8 @@ from jepsen_tpu.history import (
     fail_op,
     info_op,
 )
-from jepsen_tpu.models import CASRegister, Mutex, UnorderedQueue
+from jepsen_tpu.models import (CASRegister, FIFOQueue, Mutex,
+                               UnorderedQueue)
 from jepsen_tpu.ops import wgl_host, wgl_tpu
 
 from helpers import random_queue_history, random_register_history
@@ -283,3 +284,86 @@ class TestVerdictDivergenceRegressions:
         r = wgl_tpu.analysis(CASRegister(), hist, time_limit=1e-9)
         # budget floor is 1000 steps; small histories may still finish
         assert r.valid in (True, "unknown")
+
+
+class TestFifoKernel:
+    """The fifo-queue ring-buffer encoding (models/jit.py
+    FifoQueueJitModel): strict ordering on the kernel path."""
+
+    def test_fifo_order_enforced(self):
+        ops = [
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+        ]
+        in_order = ops + [
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 1),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 2),
+        ]
+        reversed_ = ops + [
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 2),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 1),
+        ]
+        assert tpu_valid(FIFOQueue(), h(*in_order)) is True
+        # strict FIFO rejects LIFO order the unordered model accepts
+        assert tpu_valid(FIFOQueue(), h(*reversed_)) is False
+        assert tpu_valid(UnorderedQueue(), h(*reversed_)) is True
+
+    def test_dequeue_empty_or_never_enqueued(self):
+        hist = h(invoke_op(0, "dequeue"), ok_op(0, "dequeue", 9))
+        assert tpu_valid(FIFOQueue(), hist) is False
+
+    def test_concurrent_enqueues_may_order_either_way(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1),
+            invoke_op(1, "enqueue", 2),
+            ok_op(0, "enqueue", 1),
+            ok_op(1, "enqueue", 2),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 2),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        )
+        assert tpu_valid(FIFOQueue(), hist) is True
+
+    def test_crashed_enqueue_may_have_happened(self):
+        hist = h(
+            invoke_op(0, "enqueue", 3), info_op(0, "enqueue", 3),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 3),
+        )
+        assert tpu_valid(FIFOQueue(), hist) is True
+
+    def test_duplicate_values_keep_positions(self):
+        hist = h(
+            invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+            invoke_op(0, "enqueue", 5), ok_op(0, "enqueue", 5),
+            invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 7),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 5),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 7),
+        )
+        assert tpu_valid(FIFOQueue(), hist) is True
+
+    def test_string_payloads_stay_on_kernel(self):
+        from jepsen_tpu.checker.linearizable import _tpu_eligible
+
+        hist = h(
+            invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", "a"),
+        )
+        assert _tpu_eligible(FIFOQueue(), make_entries(hist))
+        assert tpu_valid(FIFOQueue(), hist) is True
+
+    @pytest.mark.parametrize("corrupt,n_values", [
+        (0.0, None), (0.3, None), (0.0, 3), (0.3, 3),
+    ])
+    def test_randomized_parity(self, corrupt, n_values):
+        hists = [
+            random_queue_history(
+                n_process=3, n_ops=14, seed=s, corrupt=corrupt,
+                n_values=n_values, fifo=True,
+            )
+            for s in range(20)
+        ]
+        entries_list = [make_entries(hh) for hh in hists]
+        tpu_results = wgl_tpu.analysis_batch(FIFOQueue(), entries_list)
+        for hh, es, tr in zip(hists, entries_list, tpu_results):
+            hr = wgl_host.analysis(FIFOQueue(), es)
+            assert tr.valid == hr.valid, hh
