@@ -113,17 +113,30 @@ def encode_entries(es: Entries, jm, n_pad: int) -> dict:
     }
 
 
-def _hash_key(lin: jnp.ndarray, state: jnp.ndarray, state_in_key: bool) -> jnp.ndarray:
-    """FNV-ish fold of the memo key (bitset words, plus state words when
-    state participates in the key) into a uint32."""
-    h = jnp.uint32(2166136261)
-    for w in range(lin.shape[0]):
-        h = (h ^ lin[w]) * jnp.uint32(16777619)
+def _zobrist_table(n_pad: int) -> np.ndarray:
+    """One random uint32 per entry (splitmix-style, deterministic).
+    The bitset's bucket hash is maintained INCREMENTALLY: XOR the
+    entry's constant in when it linearizes, out when it backtracks —
+    O(1) per step instead of an O(n_words) fold, which dominated the
+    loop body for long histories. The exact full-key compare is what
+    guarantees soundness; this hash only picks buckets."""
+    x = np.arange(1, n_pad + 1, dtype=np.uint64) * np.uint64(
+        0x9E3779B97F4A7C15)
+    x = (x ^ (x >> 30)) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * np.uint64(0x94D049BB133111EB)
+    return ((x ^ (x >> 31)) & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def _mix_hash(h_lin: jnp.ndarray, state: jnp.ndarray,
+              state_in_key: bool) -> jnp.ndarray:
+    """Combine the incremental bitset hash with a fold of the (small)
+    state vector and avalanche into a bucket hash."""
+    h = h_lin
     if state_in_key:
         for w in range(state.shape[0]):
             h = (h ^ state[w].astype(jnp.uint32)) * jnp.uint32(16777619)
-    h = h ^ (h >> 15)
-    return h
+    h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
+    return h ^ (h >> 13)
 
 
 def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
@@ -145,12 +158,15 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
     cache_keys = jnp.zeros((cache_size, key_width), jnp.int32)
     cache_used = jnp.zeros(cache_size, bool)
 
+    ztab = jnp.asarray(_zobrist_table(n_pad))
+
     init = dict(
         nxt=ent["nxt0"].astype(jnp.int32),
         prv=ent["prv0"].astype(jnp.int32),
         node=ent["nxt0"][0].astype(jnp.int32),
         state=jnp.asarray(jm.init_vec(n_state), jnp.int32),
         linearized=jnp.zeros(n_words, jnp.uint32),
+        h_lin=jnp.uint32(2166136261),
         depth=jnp.int32(0),
         stack_e=jnp.zeros(n_pad, jnp.int32),
         completed_done=jnp.int32(0),
@@ -194,6 +210,7 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
         word = e // 32
         bit = (jnp.uint32(1) << (e % 32).astype(jnp.uint32))
         new_lin = lin.at[word].set(lin[word] | bit)
+        new_h = st["h_lin"] ^ ztab[e]  # incremental bitset hash
 
         # ---- cache probe (exact full-key compare) ----
         # canonicalized state: memo keys encode LOGICAL state (e.g. the
@@ -204,7 +221,7 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
         if jm.state_in_key:
             key_parts.append(key_state)
         key = jnp.concatenate(key_parts)
-        h = _hash_key(new_lin, key_state, jm.state_in_key)
+        h = _mix_hash(new_h, key_state, jm.state_in_key)
         probe_idx = (h[None] + jnp.arange(N_PROBES, dtype=jnp.uint32)) & mask
         probe_idx = probe_idx.astype(jnp.int32)
         slot_keys = st["cache_keys"][probe_idx]          # [P, key_width]
@@ -219,26 +236,10 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
         ins = jnp.where(has_free, probe_idx[first_free], probe_idx[-1])
 
         do_lift = can_lin & ~found
-        # ---- branch: lift ----
-        cn = call_node_arr[e]
-        rn = ret_node_arr[e]
-        # unlink call node then ret node (order immaterial for scatter
-        # since cn<rn positions are distinct and pointers are per-node)
-        l_nxt = nxt
-        l_prv = prv
-        # unlink cn
-        l_nxt = l_nxt.at[l_prv[cn]].set(l_nxt[cn])
-        l_prv = l_prv.at[l_nxt[cn]].set(l_prv[cn])
-        # unlink rn (pointers of rn still valid)
-        l_nxt = l_nxt.at[l_prv[rn]].set(l_nxt[rn])
-        l_prv = l_prv.at[l_nxt[rn]].set(l_prv[rn])
 
-        lift_stack_e = st["stack_e"].at[depth].set(e)
         lift_completed = st["completed_done"] + jnp.where(
             crashed_arr[e], 0, 1
         ).astype(jnp.int32)
-        lift_cache_keys = st["cache_keys"].at[ins].set(key)
-        lift_cache_used = st["cache_used"].at[ins].set(True)
 
         # ---- branch: backtrack (hit a return node / END) ----
         can_pop = depth > 0
@@ -253,13 +254,6 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
             pop_state = st["stack_s"][depth - 1]
         cn2 = call_node_arr[e2]
         rn2 = ret_node_arr[e2]
-        # relink rn2 then cn2 (reverse of lift order)
-        b_nxt = nxt
-        b_prv = prv
-        b_nxt = b_nxt.at[b_prv[rn2]].set(rn2)
-        b_prv = b_prv.at[b_nxt[rn2]].set(rn2)
-        b_nxt = b_nxt.at[b_prv[cn2]].set(cn2)
-        b_prv = b_prv.at[b_nxt[cn2]].set(cn2)
         word2 = e2 // 32
         bit2 = (jnp.uint32(1) << (e2 % 32).astype(jnp.uint32))
         pop_lin = lin.at[word2].set(lin[word2] & ~bit2)
@@ -267,41 +261,73 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
             crashed_arr[e2], 0, 1
         ).astype(jnp.int32)
 
-        # ---- select ----
         advance = is_call & ~do_lift  # consistent-but-seen or inconsistent
         backtrack = ~is_call
+        do_back = backtrack & can_pop
 
+        # ---- linked-list updates as four conditional SCALAR scatters
+        # (full-array selects over nxt/prv dominated the loop body).
+        # Lift unlinks cn then rn; backtrack relinks rn2 then cn2 —
+        # each is two rounds of (one nxt write, one prv write), with
+        # identity writes at the sentinel when neither branch fires.
+        cn = call_node_arr[e]
+        rn = ret_node_arr[e]
+        zero = jnp.int32(0)
+
+        posA_n = jnp.where(do_lift, prv[cn],
+                           jnp.where(do_back, prv[rn2], zero))
+        valA_n = jnp.where(do_lift, nxt[cn],
+                           jnp.where(do_back, rn2, nxt[0]))
+        posA_p = jnp.where(do_lift, nxt[cn],
+                           jnp.where(do_back, nxt[rn2], zero))
+        valA_p = jnp.where(do_lift, prv[cn],
+                           jnp.where(do_back, rn2, prv[0]))
+        nxt1 = nxt.at[posA_n].set(valA_n)
+        prv1 = prv.at[posA_p].set(valA_p)
+
+        posB_n = jnp.where(do_lift, prv1[rn],
+                           jnp.where(do_back, prv1[cn2], zero))
+        valB_n = jnp.where(do_lift, nxt1[rn],
+                           jnp.where(do_back, cn2, nxt1[0]))
+        posB_p = jnp.where(do_lift, nxt1[rn],
+                           jnp.where(do_back, nxt1[cn2], zero))
+        valB_p = jnp.where(do_lift, prv1[rn],
+                           jnp.where(do_back, cn2, prv1[0]))
+        nxt_out = nxt1.at[posB_n].set(valB_n)
+        prv_out = prv1.at[posB_p].set(valB_p)
+
+        # ---- cache + stacks: targeted conditional scatters ----
+        cache_keys_out = st["cache_keys"].at[ins].set(
+            jnp.where(do_lift, key, st["cache_keys"][ins]))
+        cache_used_out = st["cache_used"].at[ins].set(
+            st["cache_used"][ins] | do_lift)
+        stack_e_out = st["stack_e"].at[depth].set(
+            jnp.where(do_lift, e, st["stack_e"][depth]))
+
+        # ---- select scalars ----
         sel = lambda on_lift, on_adv, on_back: jnp.where(  # noqa: E731
             do_lift, on_lift, jnp.where(advance, on_adv, on_back)
         )
-        sel_arr = lambda on_lift, on_adv, on_back: jnp.where(  # noqa: E731
-            do_lift,
-            on_lift,
-            jnp.where(advance, on_adv, jnp.where(can_pop, on_back, on_adv)),
-        )
 
-        nxt_out = sel_arr(l_nxt, nxt, b_nxt)
-        prv_out = sel_arr(l_prv, prv, b_prv)
         node_out = sel(
-            l_nxt[0],
-            nxt[node],
-            jnp.where(can_pop, b_nxt[cn2], node),
+            nxt_out[0],
+            nxt_out[node],
+            jnp.where(can_pop, nxt_out[cn2], node),
         )
         state_out = sel(new_state, state, jnp.where(can_pop, pop_state, state))
         lin_out = jnp.where(
             do_lift,
             new_lin,
-            jnp.where(backtrack & can_pop, pop_lin, lin),
+            jnp.where(do_back, pop_lin, lin),
         )
+        h_out = sel(new_h, st["h_lin"],
+                    jnp.where(can_pop, st["h_lin"] ^ ztab[e2], st["h_lin"]))
         depth_out = sel(depth + 1, depth, jnp.where(can_pop, depth - 1, depth))
         completed_out = sel(
             lift_completed,
             st["completed_done"],
             jnp.where(can_pop, pop_completed, st["completed_done"]),
         )
-        stack_e_out = jnp.where(do_lift, lift_stack_e, st["stack_e"])
-        cache_keys_out = jnp.where(do_lift, lift_cache_keys, st["cache_keys"])
-        cache_used_out = jnp.where(do_lift, lift_cache_used, st["cache_used"])
 
         verdict = jnp.where(
             do_lift & (lift_completed == n_completed),
@@ -317,6 +343,7 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
             node=node_out,
             state=state_out,
             linearized=lin_out,
+            h_lin=h_out,
             depth=depth_out,
             stack_e=stack_e_out,
             completed_done=completed_out,
@@ -326,9 +353,8 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
             verdict=verdict,
         )
         if not jm.has_unstep:
-            out["stack_s"] = jnp.where(
-                do_lift, st["stack_s"].at[depth].set(state), st["stack_s"]
-            )
+            out["stack_s"] = st["stack_s"].at[depth].set(
+                jnp.where(do_lift, state, st["stack_s"][depth]))
         return out
 
     out = lax.while_loop(cond, body, init)
