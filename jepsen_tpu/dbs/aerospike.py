@@ -25,7 +25,8 @@ from .. import cli, client, generator as gen, independent, models, \
 from ..control import util as cu
 from ..history import Op
 from . import aerospike_proto as ap
-from .common import ArchiveDB, ArchiveKillNemesis, SuiteCfg
+from .common import (ArchiveDB, ArchiveKillNemesis, SuiteCfg,
+                     ready_gated_final)
 
 log = logging.getLogger("jepsen_tpu.dbs.aerospike")
 
@@ -328,6 +329,7 @@ def aerospike_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
     wl = workloads(opts)[opts.get("workload", "cas-register")]
+    db_ = AerospikeDB(archive_url=opts.get("archive_url"))
     generator = gen.time_limit(
         opts.get("time_limit", 60),
         gen.nemesis(gen.start_stop(10, 10), wl["during"]),
@@ -337,7 +339,7 @@ def aerospike_test(opts: dict) -> dict:
             generator,
             gen.nemesis(gen.once({"type": "info", "f": "stop"})),
             gen.sleep(opts.get("quiesce", 10)),
-            gen.clients(wl["final"]),
+            ready_gated_final(db_, gen.clients(wl["final"]), opts),
         )
     test = noop_test()
     test.update(opts)
@@ -345,7 +347,7 @@ def aerospike_test(opts: dict) -> dict:
         {
             "name": f"aerospike {opts.get('workload', 'cas-register')}",
             "os": osdist.debian,
-            "db": AerospikeDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": wl["client"],
             "nemesis": nemesis.partition_random_halves(),
             "model": wl.get("model"),

@@ -29,7 +29,7 @@ from .. import cli, client, generator as gen, nemesis, osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops
 from ..util import real_pmap
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, SuiteCfg, ready_gated_final
 
 log = logging.getLogger("jepsen_tpu.dbs.chronos")
 
@@ -278,13 +278,14 @@ def add_job_gen():
 def chronos_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = ChronosDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "chronos",
             "os": osdist.debian,
-            "db": ChronosDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": ChronosClient(),
             "nemesis": nemesis.partition_random_halves(),
             "generator": gen.phases(
@@ -298,7 +299,11 @@ def chronos_test(opts: dict) -> dict:
                 ),
                 gen.nemesis(gen.once({"type": "info", "f": "stop"})),
                 gen.sleep(opts.get("quiesce", 15)),
-                gen.clients(gen.once({"type": "invoke", "f": "read"})),
+                ready_gated_final(
+                    db_,
+                    gen.clients(gen.once(
+                        {"type": "invoke", "f": "read"})),
+                    opts),
             ),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),

@@ -13,6 +13,7 @@ import threading
 import time
 
 from .. import db
+from .. import generator as gen
 from ..control import util as cu
 
 log = logging.getLogger("jepsen_tpu.dbs.common")
@@ -100,17 +101,10 @@ class ArchiveDB(db.DB, db.LogFiles):
         raise NotImplementedError
 
     def await_ready(self, test, node) -> None:
-        deadline = time.monotonic() + self.ready_timeout
-        while True:
-            try:
-                if self.probe_ready(test, node):
-                    return
-            except OSError:
-                pass
-            if time.monotonic() > deadline:
-                raise db.SetupFailed(
-                    f"{self.suite.name} on {node} never became ready")
-            time.sleep(0.2)
+        down = poll_until_ready(self, test, [node], self.ready_timeout)
+        if down:
+            raise db.SetupFailed(
+                f"{self.suite.name} on {node} never became ready")
 
     def post_start(self, test, node) -> None:
         pass
@@ -232,6 +226,77 @@ class StartKillNemesis(ArchiveKillNemesis):
                                                 value=targets))
             return out.with_(f="stop")
         return super().invoke(test, op)
+
+
+def poll_until_ready(db, test, nodes, timeout: float) -> list:
+    """Poll db.probe_ready on `nodes` (in parallel) until all answer or
+    the timeout passes; returns the still-down nodes. ANY probe
+    exception counts as not-ready — a daemon mid-startup can refuse
+    connections (OSError), speak garbage HTTP (http.client errors), or
+    answer protocol-level errors ("-LOADING"), and a probe must poll
+    through all of them, never crash the caller."""
+    from ..util import real_pmap
+
+    def probe(node) -> bool:
+        try:
+            return bool(db.probe_ready(test, node))
+        except Exception:
+            return False
+
+    deadline = time.monotonic() + timeout
+    down = list(nodes)
+    while True:
+        up = real_pmap(probe, down)
+        down = [n for n, ok in zip(down, up) if not ok]
+        if not down or time.monotonic() > deadline:
+            return down
+        time.sleep(0.2)
+
+
+class AwaitReadyGen(gen.Generator):
+    """A generator gate: delay the wrapped (final) generator until every
+    node answers db.probe_ready, or the timeout passes. A kill/restart
+    nemesis's heal returns as soon as the daemon is spawned; a fixed
+    quiesce sleep races the daemon's bind on slow machines, while
+    probing is deterministic. On expiry the gate logs the still-down
+    nodes and proceeds — the final ops' own failures then tell the
+    story (the run must not hang forever on a node that never
+    revives)."""
+
+    def __init__(self, db, inner, timeout: float = 30.0):
+        """`db` is anything with probe_ready(test, node) — ArchiveDB
+        subclasses, or any DB that grows the method."""
+        self.db = db
+        self.name = getattr(getattr(db, "suite", None), "name",
+                            type(db).__name__)
+        self.inner = gen.to_gen(inner)
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._done = False
+
+    def op(self, test, process):
+        with self._lock:
+            if not self._done:
+                down = poll_until_ready(self.db, test, test["nodes"],
+                                        self.timeout)
+                if down:
+                    log.warning(
+                        "%s still not ready after %.0fs health gate: %s "
+                        "— final ops may fail",
+                        self.name, self.timeout, down)
+                self._done = True
+        return self.inner.op(test, process)
+
+
+def await_ready_gen(db, inner, timeout: float = 30.0) -> AwaitReadyGen:
+    return AwaitReadyGen(db, inner, timeout)
+
+
+def ready_gated_final(db, inner, opts: dict) -> AwaitReadyGen:
+    """The standard health-gated final phase: one place owns the
+    ready_timeout option name and default for every suite."""
+    return AwaitReadyGen(db, inner,
+                         timeout=opts.get("ready_timeout", 30.0))
 
 
 def standard_nemeses(db: ArchiveDB) -> dict:

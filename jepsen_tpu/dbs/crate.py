@@ -33,8 +33,8 @@ from .. import cli, client, generator as gen, independent, nemesis
 from .. import osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops
-from .common import ArchiveDB, SuiteCfg, once as _once, \
-    shared_flag as _shared_flag
+from .common import ArchiveDB, SuiteCfg, ready_gated_final, \
+    once as _once, shared_flag as _shared_flag
 # shared with the elasticsearch suite — identical workload shape and
 # anomaly definition (no circular import: elasticsearch doesn't import
 # crate)
@@ -396,6 +396,7 @@ def crate_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
     wl = workloads(opts)[opts.get("workload", "version-divergence")]
+    db_ = CrateDB(archive_url=opts.get("archive_url"))
     generator = gen.time_limit(
         opts.get("time_limit", 60),
         gen.nemesis(gen.start_stop(10, 10), wl["during"]),
@@ -405,7 +406,7 @@ def crate_test(opts: dict) -> dict:
             generator,
             gen.nemesis(gen.once({"type": "info", "f": "stop"})),
             gen.sleep(opts.get("quiesce", 10)),
-            gen.clients(wl["final"]),
+            ready_gated_final(db_, gen.clients(wl["final"]), opts),
         )
     test = noop_test()
     test.update(opts)
@@ -413,7 +414,7 @@ def crate_test(opts: dict) -> dict:
         {
             "name": f"crate {opts.get('workload', 'version-divergence')}",
             "os": osdist.debian,
-            "db": CrateDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": wl["client"],
             "nemesis": nemesis.partition_random_halves(),
             "generator": generator,

@@ -375,11 +375,15 @@ def dgraph_test(opts: dict) -> dict:
     if wl.get("final") is not None:
         heal = ([gen.nemesis(pkg["final_generator"])]
                 if pkg.get("final_generator") is not None else [])
+        from .common import ready_gated_final
+
         generator = gen.phases(
             generator,
             *heal,
             gen.sleep(opts.get("quiesce", 10)),
-            wl["final"],
+            # health-gate the final reads: the heal's restart returns
+            # before the daemon binds (common.AwaitReadyGen)
+            ready_gated_final(db, wl["final"], opts),
         )
     test = noop_test()
     test.update(opts)

@@ -26,7 +26,7 @@ from .. import checker as checker_mod
 from .. import cli, client, db, generator as gen, nemesis, osdist, reconnect
 from ..history import Op
 from . import redis_proto
-from .common import ArchiveDB, SuiteCfg, resp_ping_ready
+from .common import ArchiveDB, SuiteCfg, ready_gated_final, resp_ping_ready
 
 log = logging.getLogger("jepsen_tpu.dbs.disque")
 
@@ -163,13 +163,14 @@ def queue_gen() -> gen.Generator:
 def disque_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = DisqueDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "disque",
             "os": osdist.debian,
-            "db": DisqueDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": DisqueClient(),
             "nemesis": nemesis.partition_random_halves(),
             "generator": gen.phases(
@@ -184,8 +185,12 @@ def disque_test(opts: dict) -> dict:
                 gen.log("Healing cluster"),
                 gen.nemesis(gen.once({"type": "info", "f": "stop"})),
                 gen.sleep(opts.get("quiesce", 10)),
-                gen.clients(gen.each(
-                    lambda: gen.once({"type": "invoke", "f": "drain"}))),
+                ready_gated_final(
+                    db_,
+                    gen.clients(gen.each(
+                        lambda: gen.once(
+                            {"type": "invoke", "f": "drain"}))),
+                    opts),
             ),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),

@@ -28,7 +28,7 @@ import urllib.request
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, models, nemesis, osdist
 from ..history import Op
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, SuiteCfg, ready_gated_final
 
 log = logging.getLogger("jepsen_tpu.dbs.elasticsearch")
 
@@ -391,6 +391,7 @@ def es_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
     wl = workloads()[opts.get("workload", "register")]
+    db_ = EsDB(archive_url=opts.get("archive_url"))
     generator = gen.time_limit(
         opts.get("time_limit", 60),
         gen.nemesis(gen.start_stop(10, 10), wl["during"]),
@@ -400,7 +401,7 @@ def es_test(opts: dict) -> dict:
             generator,
             gen.nemesis(gen.once({"type": "info", "f": "stop"})),
             gen.sleep(opts.get("quiesce", 10)),
-            gen.clients(wl["final"]),
+            ready_gated_final(db_, gen.clients(wl["final"]), opts),
         )
     test = noop_test()
     test.update(opts)
@@ -408,7 +409,7 @@ def es_test(opts: dict) -> dict:
         {
             "name": f"elasticsearch {opts.get('workload', 'register')}",
             "os": osdist.debian,
-            "db": EsDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": wl["client"],
             "nemesis": nemesis.partition_random_halves(),
             "model": wl.get("model"),

@@ -41,6 +41,7 @@ from .. import checker as checker_mod
 from .. import cli, client, db, generator as gen, models, nemesis, osdist
 from .. import reconnect
 from ..control import util as cu
+from . import common as cmn
 from ..history import Op
 
 log = logging.getLogger("jepsen_tpu.dbs.hazelcast")
@@ -113,15 +114,18 @@ class HazelcastDB(db.DB, db.LogFiles):
         )
         self.await_ready(test, node)
 
-    def await_ready(self, test, node) -> None:
-        deadline = time.monotonic() + self.ready_timeout
+    def probe_ready(self, test, node) -> bool:
         url = (f"http://{node_host(test, node)}:{node_port(test, node)}"
                "/health")
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return resp.status == 200
+
+    def await_ready(self, test, node) -> None:
+        deadline = time.monotonic() + self.ready_timeout
         while True:
             try:
-                with urllib.request.urlopen(url, timeout=2) as resp:
-                    if resp.status == 200:
-                        return
+                if self.probe_ready(test, node):
+                    return
             except OSError:
                 pass
             if time.monotonic() > deadline:
@@ -517,6 +521,8 @@ def hazelcast_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
     wl = workloads()[opts["workload"]]
+    db_ = HazelcastDB(archive_url=opts.get("archive_url"),
+                      jdk=opts.get("install_jdk", True))
     generator = gen.time_limit(
         opts.get("time_limit", 60),
         gen.nemesis(gen.start_stop(30, 15), wl["generator"]),
@@ -528,7 +534,8 @@ def hazelcast_test(opts: dict) -> dict:
             gen.nemesis(gen.once({"type": "info", "f": "stop"})),
             gen.log("Waiting for quiescence"),
             gen.sleep(opts.get("quiesce", 500)),
-            gen.clients(wl["final_generator"]),
+            cmn.ready_gated_final(db_, gen.clients(wl["final_generator"]),
+                                opts),
         )
 
     test = noop_test()
@@ -537,8 +544,7 @@ def hazelcast_test(opts: dict) -> dict:
         {
             "name": f"hazelcast {opts['workload']}",
             "os": osdist.debian,
-            "db": HazelcastDB(archive_url=opts.get("archive_url"),
-                              jdk=opts.get("install_jdk", True)),
+            "db": db_,
             "client": wl["client"],
             "nemesis": nemesis.partition_majorities_ring(),
             "generator": generator,

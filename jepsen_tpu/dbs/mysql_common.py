@@ -455,7 +455,12 @@ def make_sql_suite(name: str, default_port: int, binary: str,
         phases = [generator,
                   gen.nemesis(gen.once({"type": "info", "f": "stop"}))]
         if wl.get("final") is not None:
-            phases += [gen.sleep(opts.get("quiesce", 10)), wl["final"]]
+            from .common import await_ready_gen
+
+            phases += [gen.sleep(opts.get("quiesce", 10)),
+                       await_ready_gen(
+                           db, wl["final"],
+                           timeout=opts.get("ready_timeout", 30.0))]
         test = noop_test()
         test.update(opts)
         test.update(

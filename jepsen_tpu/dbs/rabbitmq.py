@@ -18,7 +18,7 @@ from .. import checker as checker_mod
 from .. import cli, client, codec, generator as gen, nemesis, osdist
 from ..history import Op
 from . import amqp_proto as aq
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, SuiteCfg, ready_gated_final
 
 log = logging.getLogger("jepsen_tpu.dbs.rabbitmq")
 
@@ -120,13 +120,14 @@ def queue_gen() -> gen.Generator:
 def rabbitmq_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = RabbitMQDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "rabbitmq queue",
             "os": osdist.debian,
-            "db": RabbitMQDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": QueueClient(),
             "nemesis": nemesis.partition_random_halves(),
             "generator": gen.phases(
@@ -141,8 +142,12 @@ def rabbitmq_test(opts: dict) -> dict:
                 gen.log("Healing cluster"),
                 gen.nemesis(gen.once({"type": "info", "f": "stop"})),
                 gen.sleep(opts.get("quiesce", 10)),
-                gen.clients(gen.each(
-                    lambda: gen.once({"type": "invoke", "f": "drain"}))),
+                ready_gated_final(
+                    db_,
+                    gen.clients(gen.each(
+                        lambda: gen.once(
+                            {"type": "invoke", "f": "drain"}))),
+                    opts),
             ),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),

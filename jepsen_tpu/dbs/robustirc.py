@@ -20,7 +20,7 @@ import urllib.request
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, nemesis, osdist
 from ..history import Op
-from .common import ArchiveDB, SuiteCfg
+from .common import ArchiveDB, SuiteCfg, ready_gated_final
 
 log = logging.getLogger("jepsen_tpu.dbs.robustirc")
 
@@ -156,13 +156,14 @@ class SetClient(client.Client):
 def robustirc_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = RobustIrcDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "robustirc set",
             "os": osdist.debian,
-            "db": RobustIrcDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": SetClient(),
             "nemesis": nemesis.partition_random_halves(),
             "generator": gen.phases(
@@ -179,8 +180,12 @@ def robustirc_test(opts: dict) -> dict:
                 ),
                 gen.nemesis(gen.once({"type": "info", "f": "stop"})),
                 gen.sleep(opts.get("quiesce", 10)),
-                gen.clients(gen.each(
-                    lambda: gen.once({"type": "invoke", "f": "read"}))),
+                ready_gated_final(
+                    db_,
+                    gen.clients(gen.each(
+                        lambda: gen.once(
+                            {"type": "invoke", "f": "read"}))),
+                    opts),
             ),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),

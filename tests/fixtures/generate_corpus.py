@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from jepsen_tpu.history import Op, index  # noqa: E402
 from jepsen_tpu.models import (  # noqa: E402
     CASRegister,
+    FIFOQueue,
     Mutex,
     Register,
     UnorderedQueue,
@@ -50,6 +51,7 @@ MODELS = {
     "register": Register,
     "mutex": Mutex,
     "unordered-queue": UnorderedQueue,
+    "fifo-queue": FIFOQueue,
 }
 
 #: brute force is exact but exponential; cap the entry count it sees
@@ -135,6 +137,55 @@ def corpus_queue_history(n_process=3, n_ops=16, n_values=4, seed=0,
             history.append(Op(p, "invoke", f,
                               value if f == "enqueue" else None, time=t))
             pending[p] = (f, value, ok)
+            started += 1
+        t += 1
+    return index(history)
+
+
+def corpus_fifo_history(n_process=3, n_ops=16, n_values=4, seed=0,
+                        corrupt=0.0, crash=0.08):
+    """Concurrent enqueue/dequeue against a real FIFO — valid by
+    construction unless corrupted. Corruption alternates between an
+    order violation (dequeue the BACK of the queue) and dequeuing a
+    value never enqueued.
+
+    Only ENQUEUES may crash: a crashed dequeue's value is unknowable to
+    the searcher (its invocation carries no value), and an
+    un-linearizable dequeue whose real effect removed the front makes
+    the history genuinely non-linearizable under strict FIFO order —
+    the uncollectable front blocks every later dequeue. (The unordered
+    corpus tolerates crashed dequeues because a leftover multiset
+    element blocks nothing.)"""
+    rng = random.Random(seed)
+    history, t = [], 0
+    q: list = []
+    pending = {}
+    started = 0
+    while started < n_ops or pending:
+        p = rng.choice(range(n_process))
+        if p in pending:
+            f, value = pending.pop(p)
+            r = rng.random()
+            if r < crash and f == "enqueue":
+                history.append(Op(p, "info", f, value, time=t))
+            else:
+                history.append(Op(p, "ok", f, value, time=t))
+        elif started < n_ops:
+            if rng.random() < 0.55 or not q:
+                f = "enqueue"
+                value = rng.randrange(n_values)
+                q.append(value)
+            else:
+                f = "dequeue"
+                value = q.pop(0)  # strict FIFO
+            if corrupt and rng.random() < corrupt and f == "dequeue":
+                if rng.random() < 0.5 and q:
+                    value = q[-1]  # order violation: back of the queue
+                else:
+                    value = value + 100  # never enqueued
+            history.append(Op(p, "invoke", f,
+                              value if f == "enqueue" else None, time=t))
+            pending[p] = (f, value)
             started += 1
         t += 1
     return index(history)
@@ -272,6 +323,17 @@ def generate():
         cases.append(case(
             f"queue-{i}", "unordered-queue", hist,
             {"seed": 4000 + i, "corrupt": corrupt},
+            expect_valid=True if corrupt == 0.0 else None,
+        ))
+
+    # FIFO queue (strict ordering; corruption includes order violations)
+    for i in range(10):
+        corrupt = 0.35 * (i % 2)
+        hist = corpus_fifo_history(
+            n_process=3, n_ops=10 + 5 * i, seed=8000 + i, corrupt=corrupt)
+        cases.append(case(
+            f"fifo-{i}", "fifo-queue", hist,
+            {"seed": 8000 + i, "corrupt": corrupt},
             expect_valid=True if corrupt == 0.0 else None,
         ))
 

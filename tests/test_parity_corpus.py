@@ -15,7 +15,8 @@ import os
 import pytest
 
 from jepsen_tpu.history import entries as make_entries, ops as to_ops
-from jepsen_tpu.models import CASRegister, Mutex, Register, UnorderedQueue
+from jepsen_tpu.models import (CASRegister, FIFOQueue, Mutex, Register,
+                               UnorderedQueue)
 from jepsen_tpu.models import jit as mjit
 from jepsen_tpu.ops import linear, wgl_host
 
@@ -27,6 +28,7 @@ MODELS = {
     "register": Register,
     "mutex": Mutex,
     "unordered-queue": UnorderedQueue,
+    "fifo-queue": FIFOQueue,
 }
 
 
