@@ -240,6 +240,8 @@ def poll_until_ready(db, test, nodes, timeout: float) -> list:
     def probe(node) -> bool:
         try:
             return bool(db.probe_ready(test, node))
+        except NotImplementedError:
+            raise  # missing override is a programming error, not "down"
         except Exception:
             return False
 

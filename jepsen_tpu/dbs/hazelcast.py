@@ -121,16 +121,8 @@ class HazelcastDB(db.DB, db.LogFiles):
             return resp.status == 200
 
     def await_ready(self, test, node) -> None:
-        deadline = time.monotonic() + self.ready_timeout
-        while True:
-            try:
-                if self.probe_ready(test, node):
-                    return
-            except OSError:
-                pass
-            if time.monotonic() > deadline:
-                raise db.SetupFailed(f"hazelcast on {node} never healthy")
-            time.sleep(0.2)
+        if cmn.poll_until_ready(self, test, [node], self.ready_timeout):
+            raise db.SetupFailed(f"hazelcast on {node} never healthy")
 
     def teardown(self, test, node) -> None:
         remote = test["remote"]

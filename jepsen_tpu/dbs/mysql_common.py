@@ -455,12 +455,10 @@ def make_sql_suite(name: str, default_port: int, binary: str,
         phases = [generator,
                   gen.nemesis(gen.once({"type": "info", "f": "stop"}))]
         if wl.get("final") is not None:
-            from .common import await_ready_gen
+            from .common import ready_gated_final
 
             phases += [gen.sleep(opts.get("quiesce", 10)),
-                       await_ready_gen(
-                           db, wl["final"],
-                           timeout=opts.get("ready_timeout", 30.0))]
+                       ready_gated_final(db, wl["final"], opts)]
         test = noop_test()
         test.update(opts)
         test.update(

@@ -1,0 +1,396 @@
+"""The WGL search as ONE Pallas (Mosaic) TPU kernel per lane.
+
+ops/wgl_tpu.py expresses the DFS as a lax.while_loop of fused XLA ops;
+every loop iteration pays multi-kernel dispatch overhead, which
+dominates for the short lanes the independent checker produces. This
+module compiles the ENTIRE search loop into a single Mosaic kernel —
+one launch per batch, zero per-step dispatch — with the lane axis as
+the pallas grid.
+
+Mosaic constraints shape the data layout:
+- per-entry arrays are (n_pad, 1) int32 so every data-dependent index
+  is in the SUBLANE dimension (dynamic lane indexing is rejected);
+- scalar stores are expressed as (1, 1) dynamic-slice stores;
+- the linearized bitset lives in a (1, 128) int32 row updated with
+  iota-mask vector ops (32 bits per lane → histories up to 4064
+  entries), and the model state is packed into the row's last lane —
+  the row itself is then the exact memo key;
+- the memo cache is VMEM scratch: (2^CACHE_BITS, 128) key rows plus a
+  (2^CACHE_BITS, 1) used column, re-zeroed at the start of each grid
+  program (scratch persists across programs).
+
+Scope: scalar kernel models only (cas-register / register / mutex:
+one-int32 state, state_in_key). Vector-state models and histories
+beyond the bitset-row capacity use ops/wgl_tpu. The algorithm, search
+order, and Zobrist bucket selection match wgl_tpu/wgl_host exactly, so
+verdicts are identical and step counts match the host search whenever
+the (identically-sized) cache doesn't evict differently.
+
+On non-TPU backends the kernel runs in pallas interpret mode (used by
+the CPU test suite for parity); on TPU it compiles via Mosaic.
+
+MEASURED RESULT (v5e, 34 x ~300-op CAS lanes): correct verdicts and
+step counts, but ~0.5x the XLA kernel's throughput — Mosaic's grid
+runs lane programs sequentially on one TensorCore, and each DFS step's
+~30 data-dependent scalar VMEM accesses cost ~86us/step (cache size
+and probe count are immaterial; the dynamic accesses dominate). This
+confirms SURVEY §7.4's "irregular search on SIMD hardware" analysis:
+the XLA kernel's vmapped lockstep batching amortizes dispatch better
+than Mosaic's scalar unit handles pointer-chasing. The module stays as
+a parity-tested alternative (checker/linearizable does NOT route here)
+so future Mosaic scalar-memory improvements can be re-measured by
+calling wgl_pallas.analysis_batch directly on the bench workload.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..history import Entries, entries as make_entries
+from ..models import jit as mjit
+from .wgl_host import WGLResult, analysis as wgl_host_analysis
+from .wgl_tpu import (RUNNING, VALID, INVALID, UNKNOWN,
+                      DEFAULT_MAX_STEPS, N_PROBES, _next_pow2,
+                      _zobrist_table, encode_entries)
+
+log = logging.getLogger("jepsen_tpu.ops.wgl_pallas")
+
+CACHE_BITS = 11  # 2048 rows * 128 lanes * 4 B = 1 MB VMEM per program
+ROW = 128
+STATE_LANE = ROW - 1          # lane 127 carries the model state
+MAX_WORDS = ROW - 1           # bitset words 0..126
+MAX_PAD = MAX_WORDS * 32      # 4064 entries
+
+
+def eligible(jm, n_pad: int) -> bool:
+    """Scalar models whose bitset fits the row layout."""
+    return (isinstance(jm, mjit.JitModel)
+            and jm.state_in_key
+            and n_pad <= MAX_PAD)
+
+
+def _make_kernel(jm, n_pad: int, max_steps: int):
+    from jax.experimental import pallas as pl
+
+    m_pad = ((2 * n_pad + 1 + 7) // 8) * 8
+    cache_size = 1 << CACHE_BITS
+    # plain Python ints — jnp values created outside the kernel would
+    # be captured tracers, which pallas rejects
+    mask_c = cache_size - 1
+    init_state_c = int(jm.init_state)
+    fnv_basis_c = int(np.uint32(2166136261).astype(np.int32))
+
+    def kernel(f_ref, v1_ref, v2_ref, crashed_ref, call_ref, ret_ref,
+               entry_ref, is_call_ref, nxt0_ref, prv0_ref, ncomp_ref,
+               ztab_ref,
+               verdict_ref, steps_ref, depth_ref,
+               nxt, prv, stack_e, stack_s, cache_keys, cache_used):
+        mask = jnp.int32(mask_c)
+        init_state = jnp.int32(init_state_c)
+        fnv_basis = jnp.int32(fnv_basis_c)
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, ROW), 1)
+        # --- per-program init (scratch persists across programs) ---
+        nxt[...] = nxt0_ref[0]
+        prv[...] = prv0_ref[0]
+        cache_keys[...] = jnp.zeros((cache_size, ROW), jnp.int32)
+        cache_used[...] = jnp.zeros((cache_size, 1), jnp.int32)
+
+        n_completed = ncomp_ref[0, 0, 0]
+
+        def ld(ref, i):
+            return ref[0, i, 0]
+
+        def st1(ref, i, v):
+            ref[pl.ds(i, 1), :] = jnp.full((1, 1), v, jnp.int32)
+
+        def mix_hash(h_lin, state):
+            h = ((h_lin ^ state) * jnp.int32(16777619)).astype(jnp.uint32)
+            h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
+            return (h ^ (h >> 13)).astype(jnp.int32)
+
+        init = (
+            ld(nxt0_ref, 0),                 # node
+            init_state,                      # state
+            jnp.where(lane_iota == STATE_LANE, init_state,
+                      jnp.int32(0)),         # row: bits + state lane
+            fnv_basis,                       # h_lin
+            jnp.int32(0),                    # depth
+            jnp.int32(0),                    # completed_done
+            jnp.int32(0),                    # steps
+            jnp.where(n_completed == 0, jnp.int32(VALID),
+                      jnp.int32(RUNNING)),   # verdict
+        )
+
+        def cond(st):
+            return (st[7] == RUNNING) & (st[6] < max_steps)
+
+        def body(st):
+            node, state, row, h_lin, depth, completed, steps, _v = st
+
+            e = ld(entry_ref, node)
+            is_call = (node != 0) & (ld(is_call_ref, node) != 0)
+
+            new_state, ok = jm.step(state, ld(f_ref, e), ld(v1_ref, e),
+                                    ld(v2_ref, e))
+            new_state = new_state.astype(jnp.int32)
+            can_lin = is_call & ok
+
+            bitmask = jnp.where(lane_iota == e // 32,
+                                jnp.int32(1) << (e % 32), jnp.int32(0))
+            new_row = jnp.where(lane_iota == STATE_LANE, new_state,
+                                row | bitmask)
+            new_h = h_lin ^ ld(ztab_ref, e)
+
+            # ---- cache probe: unrolled, exact full-row compare ----
+            h = mix_hash(new_h, new_state)
+            found = jnp.int32(0)
+            ins = jnp.int32(-1)
+            last_slot = jnp.int32(0)
+            for p in range(N_PROBES):
+                slot = (h + p) & mask
+                used_p = cache_used[slot, 0]
+                row_p = cache_keys[pl.ds(slot, 1), :]
+                match = (used_p != 0) & jnp.all(row_p == new_row)
+                found = found | match.astype(jnp.int32)
+                ins = jnp.where((ins < 0) & (used_p == 0), slot, ins)
+                last_slot = slot
+            ins = jnp.where(ins < 0, last_slot, ins)
+
+            do_lift = can_lin & (found == 0)
+            advance = is_call & ~do_lift
+            backtrack = ~is_call
+
+            lift_completed = completed + jnp.where(
+                ld(crashed_ref, e) != 0, 0, 1)
+
+            # ---- backtrack candidate ----
+            can_pop = depth > 0
+            dtop = jnp.maximum(depth - 1, 0)
+            e2 = stack_e[dtop, 0]
+            pop_state = stack_s[dtop, 0]
+            cn2 = ld(call_ref, e2)
+            rn2 = ld(ret_ref, e2)
+            bitmask2 = jnp.where(lane_iota == e2 // 32,
+                                 jnp.int32(1) << (e2 % 32), jnp.int32(0))
+            pop_row = jnp.where(lane_iota == STATE_LANE, pop_state,
+                                row & ~bitmask2)
+            pop_completed = completed - jnp.where(
+                ld(crashed_ref, e2) != 0, 0, 1)
+            do_back = backtrack & can_pop
+
+            cn = ld(call_ref, e)
+            rn = ld(ret_ref, e)
+
+            # ---- linked-list: two rounds of predicated stores,
+            # reads of each round made BEFORE its stores (exactly the
+            # sequential semantics of ops/wgl_tpu.py) ----
+            zero = jnp.int32(0)
+            prv_cn, nxt_cn = prv[cn, 0], nxt[cn, 0]
+            prv_rn2, nxt_rn2 = prv[rn2, 0], nxt[rn2, 0]
+            nxt_s0, prv_s0 = nxt[0, 0], prv[0, 0]
+            posA_n = jnp.where(do_lift, prv_cn,
+                               jnp.where(do_back, prv_rn2, zero))
+            valA_n = jnp.where(do_lift, nxt_cn,
+                               jnp.where(do_back, rn2, nxt_s0))
+            posA_p = jnp.where(do_lift, nxt_cn,
+                               jnp.where(do_back, nxt_rn2, zero))
+            valA_p = jnp.where(do_lift, prv_cn,
+                               jnp.where(do_back, rn2, prv_s0))
+            st1(nxt, posA_n, valA_n)
+            st1(prv, posA_p, valA_p)
+
+            prv_rn, nxt_rn = prv[rn, 0], nxt[rn, 0]
+            prv_cn2, nxt_cn2 = prv[cn2, 0], nxt[cn2, 0]
+            nxt_s1, prv_s1 = nxt[0, 0], prv[0, 0]
+            posB_n = jnp.where(do_lift, prv_rn,
+                               jnp.where(do_back, prv_cn2, zero))
+            valB_n = jnp.where(do_lift, nxt_rn,
+                               jnp.where(do_back, cn2, nxt_s1))
+            posB_p = jnp.where(do_lift, nxt_rn,
+                               jnp.where(do_back, nxt_cn2, zero))
+            valB_p = jnp.where(do_lift, prv_rn,
+                               jnp.where(do_back, cn2, prv_s1))
+            st1(nxt, posB_n, valB_n)
+            st1(prv, posB_p, valB_p)
+
+            # ---- cache insert + stacks (predicated) ----
+            old_row = cache_keys[pl.ds(ins, 1), :]
+            cache_keys[pl.ds(ins, 1), :] = jnp.where(
+                do_lift, new_row, old_row)
+            st1(cache_used, ins,
+                cache_used[ins, 0] | do_lift.astype(jnp.int32))
+            dpush = jnp.minimum(depth, n_pad - 1)
+            st1(stack_e, dpush,
+                jnp.where(do_lift, e, stack_e[dpush, 0]))
+            st1(stack_s, dpush,
+                jnp.where(do_lift, state, stack_s[dpush, 0]))
+
+            # ---- select next scalars (post-store linked-list reads) --
+            node_out = jnp.where(
+                do_lift, nxt[0, 0],
+                jnp.where(advance, nxt[node, 0],
+                          jnp.where(can_pop, nxt[cn2, 0], node)))
+            state_out = jnp.where(
+                do_lift, new_state,
+                jnp.where(advance, state,
+                          jnp.where(can_pop, pop_state, state)))
+            row_out = jnp.where(
+                do_lift, new_row,
+                jnp.where(do_back, pop_row, row))
+            h_out = jnp.where(
+                do_lift, new_h,
+                jnp.where(do_back, h_lin ^ ld(ztab_ref, e2), h_lin))
+            depth_out = jnp.where(
+                do_lift, depth + 1,
+                jnp.where(do_back, depth - 1, depth))
+            completed_out = jnp.where(
+                do_lift, lift_completed,
+                jnp.where(do_back, pop_completed, completed))
+            verdict = jnp.where(
+                do_lift & (lift_completed == n_completed),
+                jnp.int32(VALID),
+                jnp.where(backtrack & ~can_pop, jnp.int32(INVALID),
+                          jnp.int32(RUNNING)))
+
+            return (node_out, state_out, row_out, h_out, depth_out,
+                    completed_out, steps + 1, verdict)
+
+        out = jax.lax.while_loop(cond, body, init)
+        final = jnp.where(out[7] == RUNNING, jnp.int32(UNKNOWN), out[7])
+        verdict_ref[...] = jnp.full((1, 1, 1), final, jnp.int32)
+        steps_ref[...] = jnp.full((1, 1, 1), out[6], jnp.int32)
+        depth_ref[...] = jnp.full((1, 1, 1), out[4], jnp.int32)
+
+    return kernel, m_pad
+
+
+def _pack(entries_list, jm, n_pad: int) -> dict:
+    """Stack encoded lanes as (lanes, X, 1) int32 arrays."""
+    ents = [encode_entries(es, jm, n_pad) for es in entries_list]
+    m_pad = ((2 * n_pad + 1 + 7) // 8) * 8
+
+    def col(key, size):
+        out = np.zeros((len(ents), size, 1), np.int32)
+        for i, e in enumerate(ents):
+            a = np.asarray(e[key]).astype(np.int32)
+            out[i, :a.shape[0], 0] = a
+        return out
+
+    return {
+        "f": col("f", n_pad),
+        "v1": col("v1", n_pad),
+        "v2": col("v2", n_pad),
+        "crashed": col("crashed", n_pad),
+        "call_node": col("call_node", n_pad),
+        "ret_node": col("ret_node", n_pad),
+        "node_entry": col("node_entry", m_pad),
+        "node_is_call": col("node_is_call", m_pad),
+        "nxt0": col("nxt0", m_pad),
+        "prv0": col("prv0", m_pad),
+        "n_completed": np.array(
+            [[[e["n_completed"]]] for e in ents], np.int32),
+    }
+
+
+_kernel_cache: dict = {}
+
+
+def _launcher(jm, n_pad: int, max_steps: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    key = (jm.name, n_pad, max_steps, interpret)
+    if key in _kernel_cache:
+        return _kernel_cache[key]
+
+    kernel, m_pad = _make_kernel(jm, n_pad, max_steps)
+    cache_size = 1 << CACHE_BITS
+
+    def spec(size):
+        return pl.BlockSpec((1, size, 1), lambda i: (i, 0, 0))
+
+    def run(packed):
+        lanes = packed["f"].shape[0]
+        ztab = _zobrist_table(n_pad).astype(np.int32).reshape(1, n_pad, 1)
+        in_specs = [
+            spec(n_pad), spec(n_pad), spec(n_pad), spec(n_pad),
+            spec(n_pad), spec(n_pad),
+            spec(m_pad), spec(m_pad), spec(m_pad), spec(m_pad),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, n_pad, 1), lambda i: (0, 0, 0)),
+        ]
+        out_specs = [pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0))] * 3
+        out_shape = [jax.ShapeDtypeStruct((lanes, 1, 1), jnp.int32)] * 3
+        call = pl.pallas_call(
+            kernel,
+            grid=(lanes,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((m_pad, 1), jnp.int32),   # nxt
+                pltpu.VMEM((m_pad, 1), jnp.int32),   # prv
+                pltpu.VMEM((n_pad, 1), jnp.int32),   # stack_e
+                pltpu.VMEM((n_pad, 1), jnp.int32),   # stack_s
+                pltpu.VMEM((cache_size, ROW), jnp.int32),
+                pltpu.VMEM((cache_size, 1), jnp.int32),
+            ],
+            interpret=interpret,
+        )
+        return call(
+            packed["f"], packed["v1"], packed["v2"], packed["crashed"],
+            packed["call_node"], packed["ret_node"],
+            packed["node_entry"], packed["node_is_call"],
+            packed["nxt0"], packed["prv0"], packed["n_completed"], ztab,
+        )
+
+    _kernel_cache[key] = run
+    return run
+
+
+def analysis_batch(model, entries_list, max_steps: int | None = None,
+                   interpret: bool | None = None) -> list:
+    """Check a batch of independent histories with the pallas kernel.
+    Raises on ineligible models/sizes. NOT part of production dispatch
+    (see the module docstring's measured numbers) — callers opt in
+    explicitly, as tests/test_wgl_pallas.py does."""
+    jm = mjit.for_model(model)
+    if jm is None:
+        raise ValueError(f"no kernel model for {model!r}")
+    entries_list = [es if isinstance(es, Entries) else make_entries(es)
+                    for es in entries_list]
+    if max_steps is None:
+        max_steps = DEFAULT_MAX_STEPS
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    n_pad = max(_next_pow2(max((len(es) for es in entries_list),
+                               default=2)), 8)
+    if not eligible(jm, n_pad):
+        raise ValueError(
+            f"pallas path ineligible: model={jm.name} n_pad={n_pad}")
+    for es in entries_list:
+        if not jm.lane_eligible(es):
+            raise ValueError("lane has no int32 encoding")
+
+    packed = _pack(entries_list, jm, n_pad)
+    run = _launcher(jm, n_pad, max_steps, interpret)
+    verdicts, steps, depths = jax.block_until_ready(run(packed))
+    verdicts = np.asarray(verdicts).reshape(-1)
+    steps = np.asarray(steps).reshape(-1)
+
+    results = []
+    for es, v, s in zip(entries_list, verdicts, steps):
+        if v == VALID:
+            results.append(WGLResult(valid=True, steps=int(s)))
+        elif v == INVALID:
+            # counterexample details come from the host oracle, like
+            # wgl_tpu's invalid path
+            results.append(wgl_host_analysis(model, es))
+        else:
+            results.append(WGLResult(valid="unknown", steps=int(s)))
+    return results

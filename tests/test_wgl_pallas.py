@@ -1,0 +1,110 @@
+"""Pallas (Mosaic) WGL kernel: verdict AND step parity with the host
+search, in interpret mode (the CPU suite has no Mosaic; on TPU the
+same kernel compiles natively — see ops/wgl_pallas.py's measured
+numbers for why it is not the default dispatch)."""
+
+import pytest
+
+from jepsen_tpu.history import (entries as make_entries, index,
+                                invoke_op, ok_op, fail_op, info_op)
+from jepsen_tpu.models import CASRegister, Mutex, Register, UnorderedQueue
+from jepsen_tpu.models import jit as mjit
+from jepsen_tpu.ops import wgl_host, wgl_pallas
+
+from helpers import random_register_history
+
+
+def h(*ops):
+    return index(list(ops))
+
+
+def valid(model, hist):
+    (r,) = wgl_pallas.analysis_batch(model, [hist])
+    return r.valid
+
+
+class TestLiteral:
+    def test_sequential_ok(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read"), ok_op(0, "read", 1),
+            invoke_op(0, "cas", (1, 2)), ok_op(0, "cas", (1, 2)),
+        )
+        assert valid(CASRegister(), hist) is True
+
+    def test_bad_read_with_counterexample(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read"), ok_op(0, "read", 2),
+        )
+        (r,) = wgl_pallas.analysis_batch(CASRegister(), [hist])
+        assert r.valid is False
+        assert r.op is not None  # host recovery supplies the op
+
+    def test_crash_semantics(self):
+        hist = h(
+            invoke_op(0, "write", 1), info_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        assert valid(CASRegister(), hist) is True
+        hist2 = h(
+            invoke_op(0, "write", 1), fail_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        assert valid(CASRegister(), hist2) is False
+
+    def test_mutex(self):
+        hist = h(
+            invoke_op(0, "acquire"), ok_op(0, "acquire"),
+            invoke_op(1, "acquire"), ok_op(1, "acquire"),
+        )
+        assert valid(Mutex(), hist) is False
+
+    def test_register(self):
+        hist = h(
+            invoke_op(0, "write", 7), ok_op(0, "write", 7),
+            invoke_op(1, "read"), ok_op(1, "read", 7),
+        )
+        assert valid(Register(), hist) is True
+
+    def test_empty_and_all_crashed(self):
+        assert valid(CASRegister(), []) is True
+        hist = h(invoke_op(0, "write", 1), invoke_op(1, "cas", (5, 6)))
+        assert valid(CASRegister(), hist) is True
+
+    def test_unknown_on_budget(self):
+        hist = random_register_history(n_process=4, n_ops=40, seed=7)
+        (r,) = wgl_pallas.analysis_batch(CASRegister(), [hist],
+                                         max_steps=1)
+        assert r.valid == "unknown"
+
+
+class TestEligibility:
+    def test_vector_models_rejected(self):
+        hist = h(invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1))
+        with pytest.raises(ValueError):
+            wgl_pallas.analysis_batch(UnorderedQueue(), [hist])
+
+    def test_row_capacity_bound(self):
+        assert wgl_pallas.eligible(mjit.cas_register, wgl_pallas.MAX_PAD)
+        assert not wgl_pallas.eligible(mjit.cas_register,
+                                       wgl_pallas.MAX_PAD * 2)
+
+
+class TestHostParity:
+    @pytest.mark.parametrize("corrupt", [0.0, 0.4])
+    def test_randomized_parity_with_steps(self, corrupt):
+        hists = [
+            random_register_history(n_process=3, n_ops=14, seed=s,
+                                    corrupt=corrupt)
+            for s in range(15)
+        ]
+        es_list = [make_entries(x) for x in hists]
+        rs = wgl_pallas.analysis_batch(CASRegister(), es_list)
+        for hh, es, r in zip(hists, es_list, rs):
+            hr = wgl_host.analysis(CASRegister(), es)
+            assert r.valid == hr.valid, hh
+            if r.valid is True:
+                # same algorithm, same order: steps match modulo the
+                # final accounting step
+                assert abs(r.steps - hr.steps) <= 1, (r.steps, hr.steps)
