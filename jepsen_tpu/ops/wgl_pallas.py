@@ -23,8 +23,10 @@ Scope: scalar kernel models only (cas-register / register / mutex:
 one-int32 state, state_in_key). Vector-state models and histories
 beyond the bitset-row capacity use ops/wgl_tpu. The algorithm, search
 order, and Zobrist bucket selection match wgl_tpu/wgl_host exactly, so
-verdicts are identical and step counts match the host search whenever
-the (identically-sized) cache doesn't evict differently.
+verdicts are identical, and step counts match the host search whenever
+the kernel's bounded cache (2^CACHE_BITS rows vs the host's unbounded
+memo set) doesn't evict — evictions only cost pruning, never
+soundness, but they can make kernel step counts exceed the host's.
 
 On non-TPU backends the kernel runs in pallas interpret mode (used by
 the CPU test suite for parity); on TPU it compiles via Mosaic.
@@ -67,6 +69,11 @@ MAX_WORDS = ROW - 1           # bitset words 0..126
 MAX_PAD = MAX_WORDS * 32      # 4064 entries
 
 
+def _m_pad(n_pad: int) -> int:
+    """Node-array size (2*n_pad+1) padded to Mosaic's sublane tile."""
+    return ((2 * n_pad + 1 + 7) // 8) * 8
+
+
 def eligible(jm, n_pad: int) -> bool:
     """Scalar models whose bitset fits the row layout."""
     return (isinstance(jm, mjit.JitModel)
@@ -77,7 +84,7 @@ def eligible(jm, n_pad: int) -> bool:
 def _make_kernel(jm, n_pad: int, max_steps: int):
     from jax.experimental import pallas as pl
 
-    m_pad = ((2 * n_pad + 1 + 7) // 8) * 8
+    m_pad = _m_pad(n_pad)
     cache_size = 1 << CACHE_BITS
     # plain Python ints — jnp values created outside the kernel would
     # be captured tracers, which pallas rejects
@@ -272,7 +279,7 @@ def _make_kernel(jm, n_pad: int, max_steps: int):
 def _pack(entries_list, jm, n_pad: int) -> dict:
     """Stack encoded lanes as (lanes, X, 1) int32 arrays."""
     ents = [encode_entries(es, jm, n_pad) for es in entries_list]
-    m_pad = ((2 * n_pad + 1 + 7) // 8) * 8
+    m_pad = _m_pad(n_pad)
 
     def col(key, size):
         out = np.zeros((len(ents), size, 1), np.int32)
@@ -364,13 +371,19 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         raise ValueError(f"no kernel model for {model!r}")
     entries_list = [es if isinstance(es, Entries) else make_entries(es)
                     for es in entries_list]
+    if not entries_list:
+        return []
     if max_steps is None:
         max_steps = DEFAULT_MAX_STEPS
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    n_pad = max(_next_pow2(max((len(es) for es in entries_list),
-                               default=2)), 8)
-    if not eligible(jm, n_pad):
+    n_pad = max(_next_pow2(max(len(es) for es in entries_list)), 8)
+    if n_pad > MAX_PAD:
+        # the row layout caps at MAX_PAD (a multiple of 8, not of 2):
+        # histories between the last power of two and the cap still fit
+        n_pad = MAX_PAD
+    if not eligible(jm, n_pad) \
+            or max(len(es) for es in entries_list) > n_pad:
         raise ValueError(
             f"pallas path ineligible: model={jm.name} n_pad={n_pad}")
     for es in entries_list:

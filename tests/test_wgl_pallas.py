@@ -90,6 +90,9 @@ class TestEligibility:
         assert not wgl_pallas.eligible(mjit.cas_register,
                                        wgl_pallas.MAX_PAD * 2)
 
+    def test_empty_batch(self):
+        assert wgl_pallas.analysis_batch(CASRegister(), []) == []
+
 
 class TestHostParity:
     @pytest.mark.parametrize("corrupt", [0.0, 0.4])
