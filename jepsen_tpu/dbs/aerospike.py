@@ -20,6 +20,7 @@ import random
 import socket
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, generator as gen, independent, models, \
     nemesis, osdist
 from ..control import util as cu
@@ -349,7 +350,7 @@ def aerospike_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": wl["client"],
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": wl.get("model"),
             "generator": generator,
             "checker": wl["checker"],
@@ -359,6 +360,7 @@ def aerospike_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--workload", default="cas-register",
                    choices=["cas-register", "counter", "set"])
     p.add_argument("--archive-url", dest="archive_url", default=None)

@@ -25,6 +25,7 @@ import urllib.error
 import urllib.request
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, generator as gen, nemesis, osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops
@@ -287,7 +288,7 @@ def chronos_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": ChronosClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "generator": gen.phases(
                 gen.time_limit(
                     opts.get("time_limit", 120),
@@ -315,6 +316,7 @@ def chronos_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None)
 
 

@@ -301,20 +301,51 @@ def ready_gated_final(db, inner, opts: dict) -> AwaitReadyGen:
                          timeout=opts.get("ready_timeout", 30.0))
 
 
-def standard_nemeses(db: ArchiveDB) -> dict:
+def standard_nemeses(db) -> dict:
     """The named-nemesis registry the per-DB runners share (the
     cockroach/tidb registries' common core, nemesis.clj:110-144):
-    partitions, majorities-ring, SIGSTOP pauses, bounded kill+restart."""
+    partitions, majorities-ring, SIGSTOP pauses, bounded kill+restart.
+    Suites whose DB isn't an ArchiveDB (custom daemon management) get
+    the partition entries only."""
     from .. import nemesis as nem
 
-    return {
+    out = {
         "none": lambda: nem.noop,
         "parts": nem.partition_random_halves,
         "majority-ring": nem.partition_majorities_ring,
-        "start-stop": lambda: nem.hammer_time(db.binary),
-        "start-kill": lambda: StartKillNemesis(db, 1),
-        "start-kill-2": lambda: StartKillNemesis(db, 2),
     }
+    if isinstance(db, ArchiveDB):
+        out.update({
+            "start-stop": lambda: nem.hammer_time(db.binary),
+            "start-kill": lambda: StartKillNemesis(db, 1),
+            "start-kill-2": lambda: StartKillNemesis(db, 2),
+        })
+    return out
+
+
+NEMESIS_NAMES = ("none", "parts", "majority-ring", "start-stop",
+                 "start-kill", "start-kill-2")
+PARTITION_NEMESIS_NAMES = ("none", "parts", "majority-ring")
+
+
+def pick_nemesis(db, opts: dict, default: str = "parts"):
+    """Resolve the suite's nemesis from the shared --nemesis option
+    (the cockroach/tidb CLI surface, generalized)."""
+    name = opts.get("nemesis") or default
+    registry = standard_nemeses(db)
+    if name not in registry:
+        raise ValueError(
+            f"nemesis {name!r} not available for this suite "
+            f"(have: {sorted(registry)})")
+    return registry[name]()
+
+
+def nemesis_opt(p, names=NEMESIS_NAMES, default: str = "parts") -> None:
+    """argparse surface for --nemesis. Suites whose DB can't host the
+    kill/pause modes pass PARTITION_NEMESIS_NAMES so the flag is
+    rejected at parse time, not at test-build time."""
+    p.add_argument("--nemesis", default=None, choices=list(names),
+                   help=f"named fault mode (default: {default})")
 
 
 def resp_ping_ready(suite: SuiteCfg, test, node,

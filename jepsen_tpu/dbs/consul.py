@@ -32,6 +32,7 @@ from .. import checker as checker_mod
 from .. import cli, client, generator as gen, models, nemesis, osdist
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg
+from . import common as cmn
 
 log = logging.getLogger("jepsen_tpu.dbs.consul")
 
@@ -170,15 +171,16 @@ def cas(test, process):
 def consul_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = ConsulDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "consul",
             "os": osdist.debian,
-            "db": ConsulDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": CASClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -202,6 +204,7 @@ def consul_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None,
                    help="consul release archive (or the in-repo sim "
                         "archive for hermetic runs).")

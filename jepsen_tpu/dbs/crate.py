@@ -29,6 +29,7 @@ import urllib.error
 import urllib.request
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, generator as gen, independent, nemesis
 from .. import osdist
 from ..checker import Checker
@@ -416,7 +417,7 @@ def crate_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": wl["client"],
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "generator": generator,
             "checker": wl["checker"],
         }
@@ -425,6 +426,7 @@ def crate_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--workload", default="version-divergence",
                    choices=sorted(workloads().keys()))
     p.add_argument("--archive-url", dest="archive_url", default=None)

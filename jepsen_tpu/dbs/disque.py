@@ -23,6 +23,7 @@ import socket
 import time
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, db, generator as gen, nemesis, osdist, reconnect
 from ..history import Op
 from . import redis_proto
@@ -172,7 +173,7 @@ def disque_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": DisqueClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "generator": gen.phases(
                 gen.time_limit(
                     opts.get("time_limit", 60),
@@ -202,6 +203,7 @@ def disque_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None,
                    help="disque release archive (or the in-repo sim "
                         "archive for hermetic runs).")

@@ -26,6 +26,7 @@ import urllib.parse
 import urllib.request
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, generator as gen, models, nemesis, osdist
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg, ready_gated_final
@@ -411,7 +412,7 @@ def es_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": wl["client"],
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": wl.get("model"),
             "generator": generator,
             "checker": wl["checker"],
@@ -421,6 +422,7 @@ def es_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--workload", default="register",
                    choices=sorted(workloads().keys()))
     p.add_argument("--archive-url", dest="archive_url", default=None)

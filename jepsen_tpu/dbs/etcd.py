@@ -29,6 +29,7 @@ import urllib.parse
 import urllib.request
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, db, generator as gen, independent, models, nemesis
 from ..control import util as cu
 from ..history import Op
@@ -292,6 +293,8 @@ def cas(test, process):
 def etcd_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = EtcdDB(opts.get("version", VERSION),
+                 url=opts.get("archive_url"))
     test = noop_test()
     per_key = opts.get("ops_per_key", 300)
     threads_per_key = opts.get("threads_per_key", 10)
@@ -299,10 +302,9 @@ def etcd_test(opts: dict) -> dict:
         {
             "name": "etcd",
             "os": osdist.debian,
-            "db": EtcdDB(opts.get("version", VERSION),
-                         url=opts.get("archive_url")),
+            "db": db_,
             "client": EtcdClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -340,8 +342,14 @@ def etcd_test(opts: dict) -> dict:
     return test
 
 
+def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p, names=cmn.PARTITION_NEMESIS_NAMES)
+
+
 def main(argv=None) -> None:
-    cli.main({**cli.single_test_cmd(etcd_test), **cli.serve_cmd()}, argv)
+    cli.main(
+        {**cli.single_test_cmd(etcd_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()}, argv)
 
 
 if __name__ == "__main__":

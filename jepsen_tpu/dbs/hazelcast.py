@@ -538,7 +538,7 @@ def hazelcast_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": wl["client"],
-            "nemesis": nemesis.partition_majorities_ring(),
+            "nemesis": cmn.pick_nemesis(db_, opts, default="majority-ring"),
             "generator": generator,
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -552,6 +552,7 @@ def hazelcast_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p, default="majority-ring")
     p.add_argument(
         "--workload", required=True, choices=sorted(workloads().keys()),
         help="Test workload to run, e.g. atomic-long-ids.",

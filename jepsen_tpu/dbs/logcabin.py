@@ -22,6 +22,7 @@ from .. import cli, client, generator as gen, models, nemesis, osdist
 from ..control import RemoteError
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg, once, shared_flag
+from . import common as cmn
 
 log = logging.getLogger("jepsen_tpu.dbs.logcabin")
 
@@ -151,15 +152,16 @@ def cas(test, process):
 def logcabin_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = LogCabinDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "logcabin",
             "os": osdist.debian,
-            "db": LogCabinDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": CASClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -179,6 +181,7 @@ def logcabin_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None)
 
 

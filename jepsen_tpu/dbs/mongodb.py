@@ -33,6 +33,7 @@ from ..checker import Checker
 from ..history import Op, ops as _ops
 from . import mongo_proto
 from .common import ArchiveDB, SuiteCfg, once, shared_flag
+from . import common as cmn
 
 log = logging.getLogger("jepsen_tpu.dbs.mongodb")
 
@@ -312,6 +313,9 @@ def mongodb_test(opts: dict) -> dict:
 
     wl = workloads(opts)[opts.get("workload", "document-cas")]
     flavor = opts.get("flavor", "rocks")
+    db_ = MongoDB(
+        archive_url=opts.get("archive_url"),
+        storage_engine="rocksdb" if flavor == "rocks" else None)
     test = noop_test()
     test.update(opts)
     test.update(
@@ -319,11 +323,9 @@ def mongodb_test(opts: dict) -> dict:
             "name": f"mongodb-{flavor} {opts.get('workload', 'document-cas')}",
             # mongodb-smartos runs on SmartOS; rocks on debian
             "os": osdist.smartos if flavor == "smartos" else osdist.debian,
-            "db": MongoDB(
-                archive_url=opts.get("archive_url"),
-                storage_engine="rocksdb" if flavor == "rocks" else None),
+            "db": db_,
             "client": wl["client"],
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": wl.get("model"),
             "generator": gen.time_limit(
                 opts.get("time_limit", 60),
@@ -350,6 +352,7 @@ def mongodb_smartos_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--workload", default="document-cas",
                    choices=["document-cas", "transfer"])
     p.add_argument("--archive-url", dest="archive_url", default=None)

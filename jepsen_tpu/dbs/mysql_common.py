@@ -441,13 +441,12 @@ def make_sql_suite(name: str, default_port: int, binary: str,
 
     def test_fn(opts: dict) -> dict:
         from ..testlib import noop_test
-        from .common import standard_nemeses
+        from .common import pick_nemesis
 
         wl_name = opts.get("workload", workload_names[0])
         wl = workloads(opts)[wl_name]
         db = DB(archive_url=opts.get("archive_url"))
-        nem_client = standard_nemeses(db)[
-            opts.get("nemesis") or "parts"]()
+        nem_client = pick_nemesis(db, opts)
         generator = gen.time_limit(
             opts.get("time_limit", 60),
             gen.nemesis(gen.start_stop(10, 10), wl["during"]),
@@ -482,12 +481,11 @@ def make_sql_suite(name: str, default_port: int, binary: str,
         return test
 
     def opt_spec(p) -> None:
+        from .common import nemesis_opt
+
         p.add_argument("--workload", default=workload_names[0],
                        choices=sorted(workload_names))
-        p.add_argument("--nemesis", default="parts",
-                       choices=["none", "parts", "majority-ring",
-                                "start-stop", "start-kill",
-                                "start-kill-2"])
+        nemesis_opt(p)
         p.add_argument("--archive-url", dest="archive_url", default=None)
         p.add_argument("--accounts", type=int, default=5)
         p.add_argument("--starting-balance", dest="starting_balance",

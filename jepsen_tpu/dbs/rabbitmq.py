@@ -15,6 +15,7 @@ import socket
 import time
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, codec, generator as gen, nemesis, osdist
 from ..history import Op
 from . import amqp_proto as aq
@@ -129,7 +130,7 @@ def rabbitmq_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": QueueClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "generator": gen.phases(
                 gen.time_limit(
                     opts.get("time_limit", 60),
@@ -159,6 +160,7 @@ def rabbitmq_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None)
 
 

@@ -28,6 +28,7 @@ from .. import reconnect
 from ..history import Op
 from . import redis_proto
 from .common import ArchiveDB, SuiteCfg, resp_ping_ready
+from . import common as cmn
 
 log = logging.getLogger("jepsen_tpu.dbs.raftis")
 
@@ -129,15 +130,16 @@ def w(test, process):
 def raftis_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = RaftisDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "raftis",
             "os": osdist.debian,
-            "db": RaftisDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": RaftisClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": models.Register(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -162,6 +164,7 @@ def raftis_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None,
                    help="raftis release archive (or the in-repo sim "
                         "archive for hermetic runs).")

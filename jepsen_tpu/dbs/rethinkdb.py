@@ -23,6 +23,7 @@ from .. import nemesis, osdist
 from ..history import Op
 from . import rethink_proto as rp
 from .common import ArchiveDB, SuiteCfg, once, shared_flag
+from . import common as cmn
 
 log = logging.getLogger("jepsen_tpu.dbs.rethinkdb")
 
@@ -157,16 +158,17 @@ def cas(test, process):
 def rethinkdb_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
+    db_ = RethinkDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": "rethinkdb document-cas",
             "os": osdist.debian,
-            "db": RethinkDB(archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": DocumentCasClient(
                 read_mode=opts.get("read_mode", "majority")),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -196,6 +198,7 @@ def rethinkdb_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None)
     p.add_argument("--read-mode", dest="read_mode", default="majority",
                    choices=["single", "majority", "outdated"])

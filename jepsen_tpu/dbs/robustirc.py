@@ -18,6 +18,7 @@ import urllib.error
 import urllib.request
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, generator as gen, nemesis, osdist
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg, ready_gated_final
@@ -165,7 +166,7 @@ def robustirc_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": SetClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "generator": gen.phases(
                 gen.time_limit(
                     opts.get("time_limit", 60),
@@ -197,6 +198,7 @@ def robustirc_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p)
     p.add_argument("--archive-url", dest="archive_url", default=None)
 
 

@@ -25,6 +25,7 @@ import socket
 import time
 
 from .. import checker as checker_mod
+from . import common as cmn
 from .. import cli, client, db, generator as gen, models, nemesis, osdist
 from ..control import util as cu
 from ..history import Op
@@ -277,6 +278,8 @@ def zk_test(opts: dict) -> dict:
     linearizable checkers."""
     from ..testlib import noop_test
 
+    db_ = ZookeeperDB(opts.get("version", VERSION),
+                      archive_url=opts.get("archive_url"))
     test = noop_test()
     # The reference merges opts BEFORE the suite map (zookeeper.clj:115)
     # so suite settings win; we keep the same precedence.
@@ -285,10 +288,9 @@ def zk_test(opts: dict) -> dict:
         {
             "name": "zookeeper",
             "os": osdist.debian,
-            "db": ZookeeperDB(opts.get("version", VERSION),
-                              archive_url=opts.get("archive_url")),
+            "db": db_,
             "client": ZkAtomClient(),
-            "nemesis": nemesis.partition_random_halves(),
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": models.CASRegister(0),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -311,8 +313,14 @@ def zk_test(opts: dict) -> dict:
     return test
 
 
+def _opt_spec(p) -> None:
+    cmn.nemesis_opt(p, names=cmn.PARTITION_NEMESIS_NAMES)
+
+
 def main(argv=None) -> None:
-    cli.main({**cli.single_test_cmd(zk_test), **cli.serve_cmd()}, argv)
+    cli.main(
+        {**cli.single_test_cmd(zk_test, opt_spec=_opt_spec),
+         **cli.serve_cmd()}, argv)
 
 
 if __name__ == "__main__":

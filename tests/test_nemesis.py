@@ -178,3 +178,39 @@ class TestProcessNemeses:
         trunc.invoke(test, Op("nemesis", "invoke", "truncate", None))
         cmds = [c for _, c in remote.commands if "truncate" in c]
         assert cmds and "/var/lib/db/log" in cmds[0] and "64" in cmds[0]
+
+
+class TestSharedNemesisRegistry:
+    """common.pick_nemesis / nemesis_opt: the --nemesis CLI surface
+    shared by the per-DB suites (cockroach/tidb registries' shape)."""
+
+    def test_archive_db_gets_full_registry(self):
+        from jepsen_tpu.dbs import common as cmn
+        from jepsen_tpu.dbs.consul import ConsulDB
+
+        db = ConsulDB()
+        names = set(cmn.standard_nemeses(db))
+        assert names == set(cmn.NEMESIS_NAMES)
+        assert cmn.pick_nemesis(db, {"nemesis": "start-kill"}) is not None
+
+    def test_non_archive_db_gets_partitions_only(self):
+        from jepsen_tpu.dbs import common as cmn
+        from jepsen_tpu.dbs.etcd import EtcdDB
+
+        db = EtcdDB("3.1.5")
+        names = set(cmn.standard_nemeses(db))
+        assert names == {"none", "parts", "majority-ring"}
+        with pytest.raises(ValueError):
+            cmn.pick_nemesis(db, {"nemesis": "start-kill"})
+        # default resolves fine
+        assert cmn.pick_nemesis(db, {}) is not None
+
+    def test_suite_builders_honor_the_option(self):
+        from jepsen_tpu import nemesis as nem
+        from jepsen_tpu.dbs import consul
+        from jepsen_tpu.dbs.common import StartKillNemesis
+
+        t = consul.consul_test({"nodes": ["n1"], "nemesis": "start-kill"})
+        assert isinstance(t["nemesis"], StartKillNemesis)
+        t2 = consul.consul_test({"nodes": ["n1"]})
+        assert isinstance(t2["nemesis"], nem.Partitioner)
