@@ -26,7 +26,7 @@ import urllib.request
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, generator as gen, nemesis, osdist
+from .. import cli, client, generator as gen, osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops
 from ..util import real_pmap

@@ -343,8 +343,11 @@ def pick_nemesis(db, opts: dict, default: str = "parts"):
 def nemesis_opt(p, names=NEMESIS_NAMES, default: str = "parts") -> None:
     """argparse surface for --nemesis. Suites whose DB can't host the
     kill/pause modes pass PARTITION_NEMESIS_NAMES so the flag is
-    rejected at parse time, not at test-build time."""
-    p.add_argument("--nemesis", default=None, choices=list(names),
+    rejected at parse time, not at test-build time. The argparse
+    default IS `default`, so the help text and the resolved nemesis
+    can't drift (pick_nemesis's own default only covers programmatic
+    callers that skip the CLI)."""
+    p.add_argument("--nemesis", default=default, choices=list(names),
                    help=f"named fault mode (default: {default})")
 
 

@@ -29,7 +29,7 @@ import urllib.parse
 import urllib.request
 
 from .. import checker as checker_mod
-from .. import cli, client, generator as gen, models, nemesis, osdist
+from .. import cli, client, generator as gen, models, osdist
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg
 from . import common as cmn

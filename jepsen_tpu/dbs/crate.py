@@ -30,7 +30,7 @@ import urllib.request
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, generator as gen, independent, nemesis
+from .. import cli, client, generator as gen, independent
 from .. import osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops

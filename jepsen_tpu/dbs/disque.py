@@ -24,7 +24,7 @@ import time
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, db, generator as gen, nemesis, osdist, reconnect
+from .. import cli, client, db, generator as gen, osdist, reconnect
 from ..history import Op
 from . import redis_proto
 from .common import ArchiveDB, SuiteCfg, ready_gated_final, resp_ping_ready

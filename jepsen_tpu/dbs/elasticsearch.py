@@ -27,7 +27,7 @@ import urllib.request
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, generator as gen, models, nemesis, osdist
+from .. import cli, client, generator as gen, models, osdist
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg, ready_gated_final
 

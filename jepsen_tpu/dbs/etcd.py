@@ -30,7 +30,7 @@ import urllib.request
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, db, generator as gen, independent, models, nemesis
+from .. import cli, client, db, generator as gen, independent, models
 from ..control import util as cu
 from ..history import Op
 from .. import osdist

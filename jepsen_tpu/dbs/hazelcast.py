@@ -552,7 +552,10 @@ def hazelcast_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
-    cmn.nemesis_opt(p, default="majority-ring")
+    # HazelcastDB manages its own daemon (not an ArchiveDB), so only
+    # the partition modes exist — reject others at parse time
+    cmn.nemesis_opt(p, names=cmn.PARTITION_NEMESIS_NAMES,
+                    default="majority-ring")
     p.add_argument(
         "--workload", required=True, choices=sorted(workloads().keys()),
         help="Test workload to run, e.g. atomic-long-ids.",

@@ -18,7 +18,7 @@ import logging
 import random
 
 from .. import checker as checker_mod
-from .. import cli, client, generator as gen, models, nemesis, osdist
+from .. import cli, client, generator as gen, models, osdist
 from ..control import RemoteError
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg, once, shared_flag

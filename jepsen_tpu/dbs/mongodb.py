@@ -28,7 +28,7 @@ import random
 import socket
 
 from .. import checker as checker_mod
-from .. import cli, client, generator as gen, models, nemesis, osdist
+from .. import cli, client, generator as gen, models, osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops
 from . import mongo_proto

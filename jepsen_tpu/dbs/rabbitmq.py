@@ -16,7 +16,7 @@ import time
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, codec, generator as gen, nemesis, osdist
+from .. import cli, client, codec, generator as gen, osdist
 from ..history import Op
 from . import amqp_proto as aq
 from .common import ArchiveDB, SuiteCfg, ready_gated_final

@@ -23,7 +23,7 @@ import socket
 import time
 
 from .. import checker as checker_mod
-from .. import cli, client, generator as gen, models, nemesis, osdist
+from .. import cli, client, generator as gen, models, osdist
 from .. import reconnect
 from ..history import Op
 from . import redis_proto

@@ -19,7 +19,7 @@ import socket
 
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, independent, models
-from .. import nemesis, osdist
+from .. import osdist
 from ..history import Op
 from . import rethink_proto as rp
 from .common import ArchiveDB, SuiteCfg, once, shared_flag

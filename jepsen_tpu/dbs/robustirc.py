@@ -19,7 +19,7 @@ import urllib.request
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, generator as gen, nemesis, osdist
+from .. import cli, client, generator as gen, osdist
 from ..history import Op
 from .common import ArchiveDB, SuiteCfg, ready_gated_final
 

@@ -26,7 +26,7 @@ import time
 
 from .. import checker as checker_mod
 from . import common as cmn
-from .. import cli, client, db, generator as gen, models, nemesis, osdist
+from .. import cli, client, db, generator as gen, models, osdist
 from ..control import util as cu
 from ..history import Op
 from . import zk_proto

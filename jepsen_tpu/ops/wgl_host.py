@@ -197,3 +197,26 @@ def analysis(
 def check(model: Model, history, **kw) -> dict:
     """Convenience: analysis() as a plain dict."""
     return analysis(model, history, **kw).to_dict()
+
+
+def recover_invalid(model: Model, es) -> WGLResult:
+    """Re-run the search host-side to recover counterexample details
+    for a lane an accelerator kernel already proved invalid (verdicts
+    agree by construction). Prefers the native C++ engine (~13x this
+    module); NativeUnavailable quietly falls back, any other native
+    failure is logged so real engine bugs can't hide behind the
+    fallback."""
+    import logging
+
+    try:
+        from . import wgl_native
+
+        return wgl_native.analysis(model, es)
+    except Exception as e:
+        from .wgl_native import NativeUnavailable
+
+        if not isinstance(e, NativeUnavailable):
+            logging.getLogger("jepsen_tpu.ops").warning(
+                "native counterexample recovery failed (%s); "
+                "falling back to the Python oracle", e)
+        return analysis(model, es)

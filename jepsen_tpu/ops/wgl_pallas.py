@@ -55,7 +55,7 @@ import jax.numpy as jnp
 
 from ..history import Entries, entries as make_entries
 from ..models import jit as mjit
-from .wgl_host import WGLResult, analysis as wgl_host_analysis
+from .wgl_host import WGLResult, recover_invalid
 from .wgl_tpu import (RUNNING, VALID, INVALID, UNKNOWN,
                       DEFAULT_MAX_STEPS, N_PROBES, _next_pow2,
                       _zobrist_table, encode_entries)
@@ -401,9 +401,9 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         if v == VALID:
             results.append(WGLResult(valid=True, steps=int(s)))
         elif v == INVALID:
-            # counterexample details come from the host oracle, like
-            # wgl_tpu's invalid path
-            results.append(wgl_host_analysis(model, es))
+            # counterexample recovery, native engine preferred — the
+            # same fallback chain as wgl_tpu's invalid path
+            results.append(recover_invalid(model, es))
         else:
             results.append(WGLResult(valid="unknown", steps=int(s)))
     return results

@@ -45,7 +45,8 @@ from jax import lax
 
 from ..history import Entries
 from ..models import jit as mjit
-from .wgl_host import WGLResult, analysis as wgl_host_analysis
+from .wgl_host import (WGLResult, analysis as wgl_host_analysis,
+                       recover_invalid)
 
 # verdict codes
 RUNNING, VALID, INVALID, UNKNOWN = 0, 1, 2, 3
@@ -458,9 +459,10 @@ def analysis_batch(
         valid = {VALID: True, INVALID: False, UNKNOWN: "unknown"}[v]
         r = WGLResult(valid=valid, steps=int(steps[i]))
         if valid is False:
-            # Recover counterexample details on host (only failed keys
-            # pay this cost; verdicts agree by construction)
-            r = wgl_host_analysis(model, es)
+            # Recover counterexample details host-side (only failed
+            # keys pay this cost; verdicts agree by construction),
+            # native engine preferred (wgl_host.recover_invalid).
+            r = recover_invalid(model, es)
         out.append(r)
     return out
 
