@@ -317,6 +317,7 @@ def workloads(opts: dict) -> dict:
         "sequential": dw.sequential_workload(opts),
         "linearizable-register": dw.lr_workload(opts),
         "long-fork": dw.long_fork_workload(opts),
+        "types": dw.types_workload(opts),
         "set": {
             "client": SetClient(),
             "during": gen.stagger(
@@ -408,7 +409,7 @@ def _opt_spec(p) -> None:
     p.add_argument("--workload", default="set",
                    choices=["set", "upsert", "bank", "delete",
                             "sequential", "linearizable-register",
-                            "long-fork"])
+                            "long-fork", "types"])
     p.add_argument("--archive-url", dest="archive_url", default=None)
     p.add_argument("--tracing", default=None, metavar="SPANS_JSONL",
                    help="export client/nemesis spans to this JSONL file")

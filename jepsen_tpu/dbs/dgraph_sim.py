@@ -87,6 +87,9 @@ def run_query(view: dict, query: str) -> list:
             continue
         if func == "eq" and preds.get(pred) != value:
             continue
+        # uid(0x..) is single-argument: the uid lands in the pred slot
+        if func == "uid" and uid != pred:
+            continue
         row = {}
         for f in fields:
             if f == "uid":
@@ -94,6 +97,29 @@ def run_query(view: dict, query: str) -> list:
             elif f in preds:
                 row[f] = preds[f]
         out.append(row)
+    return out
+
+
+INT64_MIN, INT64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def json_number(v):
+    """Dgraph's HTTP surface decodes JSON numbers the way Go's
+    encoding/json does — through float64 — so integers beyond 2^53
+    lose precision, and values outside int64 wrap/clip when stored
+    into an int predicate. The sim reproduces that faithfully: it is
+    exactly the type-safety anomaly the dgraph `types` workload exists
+    to demonstrate (types.clj:1-2)."""
+    if isinstance(v, bool) or not isinstance(v, int):
+        return v
+    if -(1 << 53) <= v <= (1 << 53):
+        return v
+    as_float = float(v)
+    out = int(as_float)
+    if out > INT64_MAX:
+        out = INT64_MAX
+    elif out < INT64_MIN:
+        out = INT64_MIN
     return out
 
 
@@ -291,7 +317,8 @@ class Handler(BaseHTTPRequestHandler):
                     counter += 1
                     uid = f"0x{counter:x}"
                     uids[f"blank-{i}"] = uid
-                explicit = {k: v for k, v in triple.items() if k != "uid"}
+                explicit = {k: json_number(v)
+                            for k, v in triple.items() if k != "uid"}
                 merged = dict(view.get(uid) or {})
                 merged.update(explicit)
                 writes[uid] = merged

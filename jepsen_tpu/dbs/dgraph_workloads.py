@@ -25,7 +25,9 @@ from __future__ import annotations
 
 import itertools
 import logging
+import random
 import socket
+import threading
 import urllib.error
 
 from .. import checker as checker_mod
@@ -566,5 +568,185 @@ def long_fork_workload(opts: dict) -> dict:
         "checker": checker_mod.compose({
             "perf": checker_mod.perf_checker(),
             "long-fork": wl["checker"],
+        }),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Types (types.clj): type safety & integer overflow hunting
+
+
+def type_cases() -> list:
+    """[attribute, value] pairs sweeping the integer boundaries where
+    type systems break (types.clj:137-165): ranges around byte/short/
+    int/long maxima (positive and negative), the largest exactly-
+    float/double-representable integers, and values well outside
+    signed 64-bit."""
+    interesting = [
+        0,
+        (1 << 7) - 1,        # Byte/MAX_VALUE
+        (1 << 15) - 1,       # Short/MAX_VALUE
+        (1 << 31) - 1,       # Integer/MAX_VALUE
+        (1 << 63) - 1,       # Long/MAX_VALUE
+        16777217,            # largest exact-float int + 1
+        9007199254740993,    # largest exact-double int + 1
+        3 * ((1 << 63) - 1),  # well outside signed longs
+    ]
+    values: list = []
+    for x in interesting:
+        values.extend(range(x - 8, x + 8))
+        values.extend(range(-x - 8, -x + 8))
+    # nsect-style probe between two near-Long.MAX points
+    lo, hi = 9223372036854775293, 9223372036854775299
+    values.extend(lo + (hi - lo) * i // 15 for i in range(16))
+    seen: set = set()
+    out = []
+    for a in ("foo", "int64"):
+        for v in values:
+            if (a, v) not in seen:
+                seen.add((a, v))
+                out.append([a, v])
+    return out
+
+
+class TypesClient(client_mod.Client):
+    """Writes entity-attribute-value triples and reads them back by
+    uid (types.clj:24-57). Values are [e, a, v] triples; writes create
+    fresh entities and complete with the assigned uid."""
+
+    def __init__(self, conn=None, entities=None):
+        self.conn = conn
+        self.entities = entities if entities is not None else []
+
+    def open(self, test, node):
+        conn = _open_conn(test, node)
+        # 'foo' is deliberately schemaless; only int64 declares a type
+        # (types.clj:29-30)
+        conn.alter("int64: int .\n")
+        return TypesClient(conn, self.entities)
+
+    def invoke(self, test, op: Op) -> Op:
+        e, a, v = op.value
+
+        def body():
+            with with_txn(self.conn) as t:
+                if op.f == "write":
+                    uids = t.mutate(sets=[{a: v}])
+                    uid = next(iter(uids.values()))
+                    self.entities.append(uid)
+                    return op.with_(type="ok", value=[uid, a, v])
+                if op.f == "read":
+                    rows = t.query(
+                        f"{{ q(func: uid({e})) {{ {a} }} }}")
+                    got = rows[0].get(a) if rows else None
+                    return op.with_(type="ok", value=[e, a, got])
+            raise ValueError(f"unknown op {op.f!r}")
+
+        return _complete(op, body, read_only=op.f == "read")
+
+    def close(self, test):
+        pass
+
+
+class TypesChecker(Checker):
+    """Everything written must read back EXACTLY (types.clj:59-125):
+    errs collect (entity, attribute, wrote, read) mismatches — the
+    signature of float64 coercion or int64 overflow; writes that were
+    never successfully read make the verdict unknown, not valid."""
+
+    def check(self, test, history, opts=None) -> dict:
+        state: dict = {}
+        dup_writes = []
+        for o in _ops(history):
+            if o.is_ok and o.f == "write":
+                e, a, v = o.value
+                if (e, a) in state:
+                    # the reference assert+'s here; a checker must
+                    # never crash on the anomaly it hunts — report it
+                    dup_writes.append({"entity": e, "attribute": a})
+                    continue
+                state[(e, a)] = v
+        read_state: dict = {}
+        inconsistent = []
+        errs = []
+        for o in _ops(history):
+            if not (o.is_ok and o.f == "read"):
+                continue
+            e, a, v = o.value
+            prev = read_state.get((e, a), v)
+            if prev != v:
+                # two ok reads of the same (entity, attribute) that
+                # disagree — e.g. a stale replica under a nemesis
+                inconsistent.append({"entity": e, "attribute": a,
+                                     "reads": sorted({str(prev),
+                                                      str(v)})})
+            read_state[(e, a)] = v
+            if (e, a) in state and v != state[(e, a)]:
+                errs.append({"entity": e, "attribute": a,
+                             "wrote": state[(e, a)], "read": v})
+        unread = sorted(
+            (str(k) for k in set(state) - set(read_state)))
+        mapping: dict = {}
+        for (e, a), wrote in state.items():
+            mapping.setdefault(a, {})[str(wrote)] = \
+                read_state.get((e, a))
+        errs = [dict(t) for t in
+                {tuple(sorted(x.items())) for x in errs}]
+        return {
+            "valid": (False if errs or inconsistent or dup_writes
+                      else "unknown" if unread else True),
+            "error_count": len(errs),
+            "unread_count": len(unread),
+            "errors": sorted(errs, key=str)[:32],
+            "inconsistent_reads": inconsistent[:32],
+            "duplicate_writes": dup_writes[:32],
+            "unread": unread[:32],
+            "mapping": {a: dict(sorted(m.items())[:16])
+                        for a, m in sorted(mapping.items())},
+        }
+
+
+def types_workload(opts: dict) -> dict:
+    cases = type_cases()
+    if opts.get("type_cases"):
+        # stride-sample so a bounded run still sweeps the whole
+        # boundary spectrum (small ints AND beyond-double values)
+        n = opts["type_cases"]
+        stride = max(1, len(cases) // n)
+        cases = cases[::stride][:n]
+    client = TypesClient()
+    entities = client.entities
+
+    final_cache: list = []
+    final_lock = threading.Lock()
+
+    def final():
+        # derefer calls per op request; build once (delay semantics,
+        # types.clj:176-188) — 3 read passes, dgraph "likes to stop
+        # taking writes just cuz"
+        with final_lock:
+            if not final_cache:
+                attrs = sorted({a for a, _ in cases})
+                reads = [{"type": "invoke", "f": "read",
+                          "value": [e, a, None]}
+                         for _ in range(3)
+                         for e in list(entities)
+                         for a in attrs]
+                random.shuffle(reads)
+                final_cache.append(
+                    gen.stagger(0.01, gen.seq(reads)))
+            return final_cache[0]
+
+    return {
+        "name": "types",
+        "client": client,
+        "during": gen.stagger(
+            0.01,
+            gen.seq({"type": "invoke", "f": "write",
+                     "value": [None, a, v]} for a, v in cases)),
+        "final": gen.derefer(final),
+        "checker": checker_mod.compose({
+            "perf": checker_mod.perf_checker(),
+            "types": TypesChecker(),
         }),
     }

@@ -210,12 +210,15 @@ def recover_invalid(model: Model, es) -> WGLResult:
 
     try:
         from . import wgl_native
-
+        native_unavailable = wgl_native.NativeUnavailable
+    except ImportError as e:  # wgl_native itself failed to import
+        logging.getLogger("jepsen_tpu.ops").warning(
+            "native engine unavailable (%s); using the Python oracle", e)
+        return analysis(model, es)
+    try:
         return wgl_native.analysis(model, es)
     except Exception as e:
-        from .wgl_native import NativeUnavailable
-
-        if not isinstance(e, NativeUnavailable):
+        if not isinstance(e, native_unavailable):
             logging.getLogger("jepsen_tpu.ops").warning(
                 "native counterexample recovery failed (%s); "
                 "falling back to the Python oracle", e)

@@ -220,3 +220,89 @@ def test_full_run_linearizable_register(tmp_path):
 def test_full_run_long_fork(tmp_path):
     result = _full_run(tmp_path, "long-fork")
     assert result["results"]["valid"] is True, result["results"]
+
+
+# -- types (types.clj) ------------------------------------------------------
+
+
+def test_type_cases_sweep_boundaries():
+    cases = dw.type_cases()
+    values = {v for _, v in cases}
+    assert (1 << 63) - 1 in values          # Long/MAX_VALUE
+    assert 9007199254740993 in values       # beyond double precision
+    assert 3 * ((1 << 63) - 1) in values    # outside int64
+    assert any(v < 0 for v in values)
+    attrs = {a for a, _ in cases}
+    assert attrs == {"foo", "int64"}
+
+
+def test_types_client_small_ints_roundtrip(port):
+    t = _test_map(port)
+    c = dw.TypesClient().open(t, "n1")
+    w = c.invoke(t, Op(0, "invoke", "write", [None, "int64", 42]))
+    assert w.type == "ok"
+    e = w.value[0]
+    r = c.invoke(t, Op(0, "invoke", "read", [e, "int64", None]))
+    assert r.value == [e, "int64", 42]
+
+
+def test_types_client_detects_float64_precision_loss(port):
+    """The sim reproduces dgraph's Go-JSON float64 decoding: integers
+    beyond 2^53 come back rounded — exactly the anomaly types.clj
+    hunts."""
+    t = _test_map(port)
+    c = dw.TypesClient().open(t, "n1")
+    big = 9007199254740993  # 2^53 + 1: not float64-representable
+    w = c.invoke(t, Op(0, "invoke", "write", [None, "int64", big]))
+    e = w.value[0]
+    r = c.invoke(t, Op(0, "invoke", "read", [e, "int64", None]))
+    assert r.value[2] != big  # precision lost
+    assert r.value[2] == int(float(big))
+
+
+def test_types_checker():
+    ok = [Op(0, "ok", "write", ["0x1", "foo", 5], index=0),
+          Op(0, "ok", "read", ["0x1", "foo", 5], index=1)]
+    assert dw.TypesChecker().check({}, ok, {})["valid"] is True
+    # mismatch -> invalid with the (wrote, read) pair surfaced
+    bad = [Op(0, "ok", "write", ["0x1", "foo", 9007199254740993], index=0),
+           Op(0, "ok", "read", ["0x1", "foo", 9007199254740992], index=1)]
+    res = dw.TypesChecker().check({}, bad, {})
+    assert res["valid"] is False
+    assert res["errors"][0]["wrote"] == 9007199254740993
+    assert res["errors"][0]["read"] == 9007199254740992
+    # written but never read -> unknown
+    unread = [Op(0, "ok", "write", ["0x1", "foo", 5], index=0)]
+    assert dw.TypesChecker().check({}, unread, {})["valid"] == "unknown"
+
+
+def test_full_run_types_catches_overflow(tmp_path):
+    """End-to-end: the types workload against the sim must come out
+    INVALID — the sim's faithful float64 JSON decoding corrupts the
+    big-integer cases, and the checker catches every corruption."""
+    result = _full_run(tmp_path, "types", time_limit=30,
+                       type_cases=40, quiesce=0.3)
+    types_res = result["results"]["types"]
+    assert types_res["valid"] is False, types_res
+    assert types_res["error_count"] > 0
+    for err in types_res["errors"]:
+        assert err["wrote"] != err["read"]
+        assert abs(err["wrote"]) > (1 << 53)
+
+
+def test_types_checker_reports_instead_of_crashing():
+    """Inconsistent reads and duplicate writes are REPORTED anomalies,
+    never checker crashes (the reference assert+'s; we must not)."""
+    incons = [Op(0, "ok", "write", ["0x1", "foo", 5], index=0),
+              Op(0, "ok", "read", ["0x1", "foo", 5], index=1),
+              Op(1, "ok", "read", ["0x1", "foo", 7], index=2)]
+    res = dw.TypesChecker().check({}, incons, {})
+    assert res["valid"] is False
+    assert res["inconsistent_reads"]
+    dup = [Op(0, "ok", "write", ["0x1", "foo", 5], index=0),
+           Op(1, "ok", "write", ["0x1", "foo", 6], index=1),
+           Op(0, "ok", "read", ["0x1", "foo", 5], index=2)]
+    res = dw.TypesChecker().check({}, dup, {})
+    assert res["valid"] is False
+    assert res["duplicate_writes"] == [{"entity": "0x1",
+                                        "attribute": "foo"}]
