@@ -316,6 +316,7 @@ def workloads(opts: dict) -> dict:
         "delete": dw.delete_workload(opts),
         "sequential": dw.sequential_workload(opts),
         "linearizable-register": dw.lr_workload(opts),
+        "uid-linearizable-register": dw.uid_lr_workload(opts),
         "long-fork": dw.long_fork_workload(opts),
         "types": dw.types_workload(opts),
         "set": {
@@ -409,6 +410,7 @@ def _opt_spec(p) -> None:
     p.add_argument("--workload", default="set",
                    choices=["set", "upsert", "bank", "delete",
                             "sequential", "linearizable-register",
+                            "uid-linearizable-register",
                             "long-fork", "types"])
     p.add_argument("--archive-url", dest="archive_url", default=None)
     p.add_argument("--tracing", default=None, metavar="SPANS_JSONL",

@@ -106,21 +106,21 @@ INT64_MIN, INT64_MAX = -(1 << 63), (1 << 63) - 1
 def json_number(v):
     """Dgraph's HTTP surface decodes JSON numbers the way Go's
     encoding/json does — through float64 — so integers beyond 2^53
-    lose precision, and values outside int64 wrap/clip when stored
-    into an int predicate. The sim reproduces that faithfully: it is
-    exactly the type-safety anomaly the dgraph `types` workload exists
-    to demonstrate (types.clj:1-2)."""
+    lose precision, and values whose float64 image falls outside int64
+    convert the way amd64's cvttsd2si does: to INT64_MIN (the x86
+    "integer indefinite"), NOT a clip to the nearest bound. Clipping
+    would make exactly 2^63-1 round-trip cleanly (float rounds it up
+    to 2^63, the clip brings it back) and hide the anomaly at the one
+    boundary the dgraph `types` workload most wants to probe
+    (types.clj:1-2)."""
     if isinstance(v, bool) or not isinstance(v, int):
         return v
     if -(1 << 53) <= v <= (1 << 53):
         return v
     as_float = float(v)
-    out = int(as_float)
-    if out > INT64_MAX:
-        out = INT64_MAX
-    elif out < INT64_MIN:
-        out = INT64_MIN
-    return out
+    if as_float >= float(1 << 63) or as_float < float(INT64_MIN):
+        return INT64_MIN
+    return int(as_float)
 
 
 def conflict_keys(touched: dict, upsert_preds: set) -> list:

@@ -633,7 +633,10 @@ class TypesClient(client_mod.Client):
                 if op.f == "write":
                     uids = t.mutate(sets=[{a: v}])
                     uid = next(iter(uids.values()))
-                    self.entities.append(uid)
+                    # record the attribute too: the final phase reads
+                    # each entity under the one attribute it was
+                    # written with, not the full cross product
+                    self.entities.append((uid, a))
                     return op.with_(type="ok", value=[uid, a, v])
                 if op.f == "read":
                     rows = t.query(
@@ -726,12 +729,10 @@ def types_workload(opts: dict) -> dict:
         # taking writes just cuz"
         with final_lock:
             if not final_cache:
-                attrs = sorted({a for a, _ in cases})
                 reads = [{"type": "invoke", "f": "read",
                           "value": [e, a, None]}
                          for _ in range(3)
-                         for e in list(entities)
-                         for a in attrs]
+                         for e, a in list(entities)]
                 random.shuffle(reads)
                 final_cache.append(
                     gen.stagger(0.01, gen.seq(reads)))
@@ -748,5 +749,93 @@ def types_workload(opts: dict) -> dict:
         "checker": checker_mod.compose({
             "perf": checker_mod.perf_checker(),
             "types": TypesChecker(),
+        }),
+    }
+
+
+class UidLrClient(client_mod.Client):
+    """The uid-variant register client (linearizable_register.clj:
+    80-150): keys map to uids through a client-side shared map instead
+    of an @upsert index, avoiding the false linearization points index
+    conflicts could introduce. A write that loses the uid-creation
+    race completes :fail :lost-uid-race — its value will never be
+    read."""
+
+    def __init__(self, conn=None, uids=None, lock=None):
+        self.conn = conn
+        self.uids = uids if uids is not None else {}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        conn = _open_conn(test, node)
+        conn.alter("value: int .\n")
+        return UidLrClient(conn, self.uids, self.lock)
+
+    def _uid_read(self, t, k):
+        with self.lock:
+            u = self.uids.get(k)
+        if u is None:
+            return None
+        rows = t.query(f"{{ q(func: uid({u})) {{ uid value }} }}")
+        assert len(rows) < 2, rows
+        return rows[0] if rows else None
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+
+        def body():
+            with with_txn(self.conn) as t:
+                if op.f == "read":
+                    rec = self._uid_read(t, k)
+                    return op.with_(
+                        type="ok",
+                        value=independent.tuple_(
+                            k, rec.get("value") if rec else None))
+                if op.f == "write":
+                    with self.lock:
+                        u = self.uids.get(k)
+                    if u is not None:
+                        t.mutate(sets=[{"uid": u, "value": v}])
+                        return op.with_(type="ok")
+                    new_u = next(iter(
+                        t.mutate(sets=[{"value": v}]).values()))
+                    with self.lock:
+                        # record iff nobody else won the race meanwhile
+                        won = self.uids.setdefault(k, new_u) == new_u
+                    if won:
+                        return op.with_(type="ok")
+                    return op.with_(type="fail", error="lost-uid-race")
+                if op.f == "cas":
+                    expect, new = v
+                    rec = self._uid_read(t, k)
+                    if rec is None:
+                        return op.with_(type="fail", error="not-found")
+                    if rec.get("value") != expect:
+                        return op.with_(type="fail",
+                                        error="value-mismatch")
+                    t.mutate(sets=[{"uid": rec["uid"], "value": new}])
+                    return op.with_(type="ok")
+            raise ValueError(f"unknown op {op.f!r}")
+
+        return _complete(op, body, read_only=op.f == "read")
+
+    def close(self, test):
+        pass
+
+
+def uid_lr_workload(opts: dict) -> dict:
+    """linearizable_register.clj:152-160's uid-workload: the stock
+    per-key register bundle over UidLrClient, with the reference's
+    larger per-key budget."""
+    wl = lr_wl.test({**opts, "per_key_limit":
+                     opts.get("per_key_limit", 1024)})
+    return {
+        "name": "uid-linearizable-register",
+        "client": UidLrClient(),
+        "during": gen.stagger(0.05, wl["generator"]),
+        "model": wl["model"],
+        "checker": checker_mod.compose({
+            "perf": checker_mod.perf_checker(),
+            "register": wl["checker"],
         }),
     }

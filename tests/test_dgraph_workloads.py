@@ -306,3 +306,60 @@ def test_types_checker_reports_instead_of_crashing():
     assert res["valid"] is False
     assert res["duplicate_writes"] == [{"entity": "0x1",
                                         "attribute": "foo"}]
+
+
+# -- uid linearizable register ---------------------------------------------
+
+
+def test_uid_lr_client(port):
+    t = _test_map(port)
+    c = dw.UidLrClient().open(t, "n1")
+    # read before any write: no uid mapping yet
+    assert c.invoke(t, Op(0, "invoke", "read", (5, None))).value == (5, None)
+    # cas before create: not-found
+    nf = c.invoke(t, Op(0, "invoke", "cas", (5, (1, 2))))
+    assert nf.type == "fail" and nf.error == "not-found"
+    assert c.invoke(t, Op(0, "invoke", "write", (5, 3))).type == "ok"
+    assert c.invoke(t, Op(0, "invoke", "read", (5, None))).value == (5, 3)
+    assert c.invoke(t, Op(0, "invoke", "cas", (5, (3, 4)))).type == "ok"
+    assert c.invoke(t, Op(0, "invoke", "read", (5, None))).value == (5, 4)
+
+
+def test_uid_lr_lost_race(port):
+    """Two clients creating the same key concurrently: exactly one
+    write wins the uid-map race; the loser must :fail (its value is
+    unreachable, linearizable_register.clj:120-135)."""
+    t = _test_map(port)
+    proto = dw.UidLrClient()
+    c1 = proto.open(t, "n1")
+    c2 = proto.open(t, "n1")  # shared uid map, like worker clients
+    # Simulate the race: both create before either records the uid
+    with dw.with_txn(c1.conn) as tx1:
+        u1 = next(iter(tx1.mutate(sets=[{"value": 1}]).values()))
+    with dw.with_txn(c2.conn) as tx2:
+        u2 = next(iter(tx2.mutate(sets=[{"value": 2}]).values()))
+    assert proto.uids.setdefault(9, u1) == u1   # c1 wins
+    assert proto.uids.setdefault(9, u2) == u1   # c2 loses
+    # After the race, both clients read the winner's value
+    r = c2.invoke(t, Op(1, "invoke", "read", (9, None)))
+    assert r.value == (9, 1)
+
+
+def test_full_run_uid_linearizable_register(tmp_path):
+    result = _full_run(tmp_path, "uid-linearizable-register",
+                       per_key_limit=40)
+    assert result["results"]["valid"] is True, result["results"]
+
+
+def test_sim_int64_boundary_is_not_masked():
+    """Exactly 2^63-1 must NOT round-trip: float64 rounds it to 2^63,
+    and the amd64-style conversion lands on INT64_MIN — a clip to
+    INT64_MAX would hide the anomaly at the headline boundary."""
+    from jepsen_tpu.dbs.dgraph_sim import json_number
+
+    assert json_number((1 << 63) - 1) == -(1 << 63)
+    assert json_number(3 * ((1 << 63) - 1)) == -(1 << 63)
+    assert json_number(-(1 << 63)) == -(1 << 63)
+    assert json_number((1 << 53)) == (1 << 53)       # still exact
+    assert json_number((1 << 53) + 1) == (1 << 53)   # precision loss
+    assert json_number(42) == 42
