@@ -30,6 +30,7 @@ from __future__ import annotations
 import logging
 import random
 import socket
+import threading
 import time
 from contextlib import contextmanager
 
@@ -505,6 +506,76 @@ def strobe_skews() -> dict:
     }
 
 
+def update_keyrange(test, table: str, k) -> None:
+    """Record that the test touched (table, k), so the split nemesis
+    can split just-written ranges (cockroach.clj:121-128). A test map
+    without a keyrange simply doesn't track (the reference throws; here
+    workloads always install one via the shared scaffold)."""
+    kr = test.get("keyrange")
+    if kr is None:
+        return
+    with kr["lock"]:
+        kr["keys"].setdefault(table, set()).add(k)
+
+
+class SplitNemesis(nemesis.Nemesis):
+    """Splits a table range just below a recently written key
+    (nemesis.clj:273-308): pick a not-yet-split key from the test's
+    keyrange and issue `ALTER TABLE .. SPLIT AT VALUES (k)` on a
+    random node; re-splitting is reported, not an error."""
+
+    def __init__(self):
+        self._already: dict = {}
+
+    def invoke(self, test, op: Op) -> Op:
+        kr = test.get("keyrange")
+        if kr is None:
+            return op.with_(type="info", value="no-keyrange")
+        with kr["lock"]:
+            candidates = [
+                (t, k) for t, ks in kr["keys"].items()
+                for k in ks - self._already.get(t, set())]
+        if not candidates:
+            return op.with_(type="info", value="nothing-to-split")
+        table, k = random.choice(candidates)
+        node = random.choice(list(test["nodes"]))
+        wrapper = None
+        try:
+            # inside the try: conn_wrapper connects eagerly, and a
+            # down node (e.g. split composed with start-kill) must
+            # complete as an error value, not crash the nemesis worker
+            wrapper = conn_wrapper(test, node)
+            lit = k if isinstance(k, (int, float)) else f"'{k}'"
+            with wrapper.with_conn() as c:
+                c.query(f"alter table {table} split at values ({lit})")
+            self._already.setdefault(table, set()).add(k)
+            return op.with_(type="info", value=["split", table, k])
+        except pg_proto.PgError as e:
+            if "already split" in str(e):
+                self._already.setdefault(table, set()).add(k)
+                return op.with_(type="info",
+                                value=["already-split", table, k])
+            return op.with_(type="info", value=["error", str(e)])
+        except (OSError, TimeoutError) as e:
+            return op.with_(type="info", value=["error", str(e)])
+        finally:
+            if wrapper is not None:
+                wrapper.close()
+
+
+def splits() -> dict:
+    """The split-nemesis package (nemesis.clj:310-316). A bare op dict
+    coerces to a repeat-forever generator under gen.delay."""
+    return {
+        "during": gen.delay(2, {"type": "info", "f": "split"}),
+        "final": None,
+        "name": "splits",
+        "client": SplitNemesis(),
+        "clocks": False,
+        "fs": ("split",),  # compose routing vocabulary
+    }
+
+
 def _named_f_gen(name: str, inner) -> gen.Generator:
     """Wrap a nemesis's generator so emitted fs become (name, f) tuples
     for compose routing (nemesis.clj:84-103)."""
@@ -526,8 +597,10 @@ def compose_nemeses(nemeses: list) -> dict:
     routes = {}
     for nem in nemeses:
         name = nem["name"]
-        routes[_FMap({(name, "start"): "start",
-                      (name, "stop"): "stop"})] = nem["client"]
+        # a package may declare its op vocabulary; start/stop is the
+        # partition-style default (splits emit f="split")
+        fs = nem.get("fs", ("start", "stop"))
+        routes[_FMap({(name, f): f for f in fs})] = nem["client"]
     return {
         "name": "+".join(n["name"] for n in nemeses),
         "clocks": any(n.get("clocks") for n in nemeses),
@@ -555,6 +628,7 @@ def nemeses() -> dict:
         "big-skews": big_skews,
         "huge-skews": huge_skews,
         "strobe-skews": strobe_skews,
+        "split": splits,
     }
 
 
@@ -608,6 +682,9 @@ def basic_test(opts: dict, workload: dict) -> dict:
             "generator": gen.phases(*phases),
             "checker": workload["checker"],
             "model": workload.get("model"),
+            # written-key tracker for the split nemesis
+            # (cockroach.clj:112-128's :keyrange atom)
+            "keyrange": {"lock": threading.Lock(), "keys": {}},
         }
     )
     return test

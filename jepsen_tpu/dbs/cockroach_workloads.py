@@ -87,6 +87,7 @@ class RegisterClient(client.Client):
                         else:
                             c.query(f"insert into test values ({k}, {v})")
                 cr.txn_retry(w)
+                cr.update_keyrange(test, "test", k)
                 return op.with_(type="ok")
             if op.f == "cas":
                 old, new = v
@@ -218,6 +219,8 @@ class BankClient(client.Client):
                                 f"where id = {frm}")
                         c.query(f"update accounts set balance = {b2} "
                                 f"where id = {to}")
+                        cr.update_keyrange(test, "accounts", frm)
+                        cr.update_keyrange(test, "accounts", to)
                         return op.with_(type="ok")
                     raise ValueError(f"unknown op {op.f!r}")
 
@@ -284,6 +287,7 @@ class SetsClient(client.Client):
             if op.f == "add":
                 cr.txn_retry(lambda: c.query(
                     f"insert into sets values ({op.value})"))
+                cr.update_keyrange(test, "sets", op.value)
                 return op.with_(type="ok")
             if op.f == "read":
                 vals = sorted(

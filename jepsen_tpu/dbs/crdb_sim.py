@@ -170,6 +170,22 @@ def execute(data: dict, sql: str) -> tuple:
         data["tables"][name] = {"cols": cols, "rows": []}
         return [], [], "CREATE TABLE"
 
+    # `alter table t split at values (k)` — CockroachDB's range-split
+    # hint (cockroach/client.clj:304-311). The sim records the split
+    # point per table (sharding is internal, so data is unaffected) and
+    # rejects re-splitting with the server's message, which the split
+    # nemesis pattern-matches (nemesis.clj:295-299).
+    m = re.fullmatch(r"alter\s+table\s+(\w+)\s+split\s+at\s+values\s*"
+                     rf"\(\s*({_LIT})\s*\)", s, re.I)
+    if m:
+        t = _table(data, m.group(1).lower())
+        k = _parse_lit(m.group(2))
+        splits = t.setdefault("splits", [])
+        if k in splits:
+            raise SqlError("XX000", "range is already split")
+        splits.append(k)
+        return [], [], "ALTER TABLE"
+
     # crate-style implicit MVCC column: `alter table t add _version`
     # gives every row a server-managed _version (1 on insert, bumped on
     # every update) that WHERE clauses may check optimistically

@@ -440,3 +440,74 @@ class TestNewWorkloadRuns:
         result = core.run(t)
         res = result["results"]
         assert res["valid"] is True, res
+
+
+class TestSplitNemesis:
+    def test_sim_split_statement(self, tmp_path):
+        from jepsen_tpu.dbs import crdb_sim
+
+        data = {}
+        crdb_sim.execute(data, "create table test (id int, val int)")
+        cols, rows, tag = crdb_sim.execute(
+            data, "alter table test split at values (5)")
+        assert tag == "ALTER TABLE"
+        with pytest.raises(crdb_sim.SqlError) as ei:
+            crdb_sim.execute(data, "alter table test split at values (5)")
+        assert "already split" in str(ei.value)
+
+    def test_update_keyrange_and_pick(self):
+        import threading
+
+        t = {"keyrange": {"lock": threading.Lock(), "keys": {}}}
+        cr.update_keyrange(t, "test", 3)
+        cr.update_keyrange(t, "test", 3)
+        cr.update_keyrange(t, "accounts", 1)
+        assert t["keyrange"]["keys"] == {"test": {3}, "accounts": {1}}
+        # no keyrange installed: silently ignored
+        cr.update_keyrange({}, "test", 9)
+
+    def test_full_run_register_with_splits(self, tmp_path):
+        """End-to-end: register workload under the split nemesis — the
+        run stays valid and at least one real split lands."""
+        t = _engine_test(tmp_path, "register", time_limit=6,
+                         ops_per_key=20, threads_per_key=2,
+                         nemesis="split")
+        result = core.run(t)
+        res = result["results"]
+        assert res["valid"] is True, res
+        split_ops = [o for o in result["history"]
+                     if o.process == "nemesis" and o.type == "info"
+                     and isinstance(o.value, list)
+                     and o.value and o.value[0] == "split"]
+        assert split_ops, [
+            (o.f, o.value) for o in result["history"]
+            if o.process == "nemesis"][:6]
+
+    def test_full_run_composed_parts_plus_split(self, tmp_path):
+        """--nemesis parts --nemesis2 split must route split ops to the
+        split client through the composed (name, f) vocabulary."""
+        t = _engine_test(tmp_path, "register", time_limit=6,
+                         ops_per_key=20, threads_per_key=2,
+                         nemesis="parts", nemesis2="split")
+        t["net"] = None
+        # partitions can't run hermetically: keep the route, stub the
+        # partitioner by healing through a no-op net
+        from jepsen_tpu import net as net_mod
+
+        class NoopNet(net_mod.Net):
+            def drop(self, test, src, dst): pass
+            def heal(self, test): pass
+            def slow(self, test): pass
+            def flaky(self, test): pass
+            def fast(self, test): pass
+            def drop_all(self, test, grudge): pass
+
+        t["net"] = NoopNet()
+        result = core.run(t)
+        history = result["history"]
+        split_ops = [o for o in history
+                     if o.process == "nemesis" and o.type == "info"
+                     and isinstance(o.value, list)
+                     and o.value and o.value[0] == "split"]
+        assert split_ops, [(o.f, str(o.value)[:40]) for o in history
+                           if o.process == "nemesis"][:8]
