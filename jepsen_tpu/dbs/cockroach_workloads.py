@@ -379,9 +379,11 @@ class MonotonicClient(client.Client):
         def create():
             with self.conn.with_conn() as c:
                 cr.txn_retry(lambda: c.query("drop table if exists mono"))
+                # val as the primary key (monotonic.clj:32-48's
+                # val-as-pkey? mode) so split-at-val hits real ranges
                 cr.txn_retry(lambda: c.query(
-                    "create table mono (val int, sts string, node int, "
-                    "process int, tb int)"))
+                    "create table mono (val int primary key, sts string, "
+                    "node int, process int, tb int)"))
 
         _once(self.flag, create)
 
@@ -400,6 +402,7 @@ class MonotonicClient(client.Client):
                             "insert into mono (val, sts, node, process, tb)"
                             f" values ({cur + 1}, '{sts}', {self.nodenum},"
                             f" {op.process}, 0)")
+                        cr.update_keyrange(test, "mono", cur + 1)
                         return cur + 1
 
                 val = cr.txn_retry(run)
@@ -487,6 +490,7 @@ class G2Client(client.Client):
                         c.query(
                             f"insert into {table} (id, key, value) "
                             f"values ({row_id}, {k}, 30)")
+                        cr.update_keyrange(test, table, row_id)
                         return op.with_(type="ok")
 
                 return cr.txn_retry(run, attempts=5)
@@ -579,6 +583,7 @@ class SequentialClient(client.Client):
                     cr.txn_retry(lambda sub=sub: c.query(
                         f"insert into {self._table(sub)} (key) "
                         f"values ('{sub}')"))
+                    cr.update_keyrange(test, self._table(sub), sub)
                 return op.with_(type="ok")
             if op.f == "read":
                 found = []
@@ -698,6 +703,8 @@ class CommentsClient(client.Client):
                 cr.txn_retry(lambda: c.query(
                     f"insert into {self._table(comment_id)} (id, key) "
                     f"values ({comment_id}, {k})"))
+                cr.update_keyrange(test, self._table(comment_id),
+                                   comment_id)
                 return op.with_(type="ok")
             if op.f == "read":
                 def run():
