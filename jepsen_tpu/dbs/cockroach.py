@@ -245,6 +245,10 @@ def exception_to_op(op, e):
         if e.retryable:
             return op.with_(type="fail", error=("restart-transaction",
                                                 e.message))
+        if e.sqlstate == "23505":
+            # unique violation: the insert definitely did NOT commit
+            return op.with_(type="fail", error=("duplicate-key",
+                                                e.message))
         return op.with_(type="info", error=("psql-exception", str(e)))
     if isinstance(e, ConnectionRefusedError):
         return op.with_(type="fail", error="connection-refused")

@@ -161,13 +161,22 @@ def execute(data: dict, sql: str) -> tuple:
                 return [], [], "CREATE TABLE"
             raise SqlError("42P07", f'relation "{name}" already exists')
         cols = []
+        pkey = None
         for coldef in m.group(3).split(","):
             word = coldef.strip().split()
             if not word or word[0].lower() in ("primary", "unique",
                                                "constraint", "index"):
                 continue  # table-level constraint, not a column
             cols.append(word[0].lower())
-        data["tables"][name] = {"cols": cols, "rows": []}
+            # inline `<col> <type> primary key`
+            if "primary" in (w.lower() for w in word[1:]):
+                pkey = word[0].lower()
+        # legacy convention: an `id` column acts as the key even
+        # without a declared constraint (matches the old hardcoded
+        # duplicate check, which several suites rely on)
+        if pkey is None and "id" in cols:
+            pkey = "id"
+        data["tables"][name] = {"cols": cols, "rows": [], "pkey": pkey}
         return [], [], "CREATE TABLE"
 
     # `alter table t split at values (k)` — CockroachDB's range-split
@@ -213,9 +222,10 @@ def execute(data: dict, sql: str) -> tuple:
             if "_version" in t["cols"] and "_version" not in by_col:
                 by_col["_version"] = 1  # server-managed MVCC column
             row = [by_col.get(c) for c in t["cols"]]
-            # primary-key-ish duplicate check on an `id` column
-            if "id" in by_col and any(
-                r.get("id") == by_col["id"] for r in _rows_as_dicts(t)
+            # duplicate check on the declared primary key column
+            pk = t.get("pkey")
+            if pk and pk in by_col and any(
+                r.get(pk) == by_col[pk] for r in _rows_as_dicts(t)
             ):
                 raise SqlError(
                     "23505", "duplicate key value violates unique constraint")

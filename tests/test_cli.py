@@ -208,3 +208,18 @@ class TestAnalyzeSubcommand:
             return t
 
         assert cli.run_cli(cli.single_test_cmd(renamed), ["analyze"]) == 255
+
+
+def test_suite_discovery_lists_all_suites(capsys):
+    """python -m jepsen_tpu.dbs prints every suite with its workloads."""
+    from jepsen_tpu.dbs import SUITES
+    from jepsen_tpu.dbs.__main__ import main, workload_choices
+
+    main()
+    out = capsys.readouterr().out
+    for name in SUITES:
+        assert name in out
+    assert "uid-linearizable-register" in out  # dgraph workloads listed
+    assert workload_choices("jepsen_tpu.dbs.tidb") == ["bank", "register",
+                                                       "sets"]
+    assert workload_choices("jepsen_tpu.dbs.disque") == []

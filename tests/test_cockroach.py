@@ -483,6 +483,46 @@ class TestSplitNemesis:
             (o.f, o.value) for o in result["history"]
             if o.process == "nemesis"][:6]
 
+    def test_composed_during_flows_through_engine(self, tmp_path):
+        """compose_nemeses' DURING generator must deliver both
+        packages' (name, f) ops through core.run's nemesis worker.
+        Deterministic: the second package is a fast recorder with no
+        sleeps, so gen.mix draws both vocabularies many times within
+        the window."""
+        from jepsen_tpu import nemesis as nem_mod
+
+        seen = []
+
+        class Recorder(nem_mod.Nemesis):
+            def invoke(self, test, op):
+                seen.append(op.f)
+                return op.with_(type="info", value="tick")
+
+        ticks = {"name": "ticks",
+                 "during": {"type": "info", "f": "tick"},
+                 "final": None,
+                 "client": Recorder(),
+                 "clocks": False,
+                 "fs": ("tick",)}
+        composed = cr.compose_nemeses([cr.splits(), ticks])
+        assert composed["name"] == "splits+ticks"
+
+        t = _engine_test(tmp_path, "register", time_limit=5,
+                         ops_per_key=20, threads_per_key=2)
+        t["nemesis"] = composed["client"]
+        t["generator"] = gen.phases(gen.time_limit(
+            5, gen.nemesis(composed["during"],
+                           t["generator"])))
+        result = core.run(t)
+        history = result["history"]
+        nem_fs = [o.f for o in history if o.process == "nemesis"]
+        assert ("ticks", "tick") in nem_fs, nem_fs[:6]
+        assert ("splits", "split") in nem_fs, nem_fs[:6]
+        split_vals = [o.value for o in history
+                      if o.process == "nemesis" and o.type == "info"
+                      and o.f == ("splits", "split") and o.value]
+        assert split_vals, "split ops consumed but none completed"
+
     def test_composed_routing_carries_split_ops(self, tmp_path):
         """--nemesis parts --nemesis2 split: the composed client must
         route ('splits', 'split') ops to the split nemesis (packages
