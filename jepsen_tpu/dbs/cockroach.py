@@ -567,11 +567,15 @@ class SplitNemesis(nemesis.Nemesis):
                 wrapper.close()
 
 
-def splits() -> dict:
+def splits(interval: float = 2.0) -> dict:
     """The split-nemesis package (nemesis.clj:310-316). A bare op dict
-    coerces to a repeat-forever generator under gen.delay."""
+    coerces to a repeat-forever generator under gen.delay. `interval`
+    paces the splits; note that under gen.mix a slow member's delay
+    runs inside op() and starves its siblings' share of a bounded
+    window (same hazard as the reference's generator.clj:337-349), so
+    tests composing this package should shrink it."""
     return {
-        "during": gen.delay(2, {"type": "info", "f": "split"}),
+        "during": gen.delay(interval, {"type": "info", "f": "split"}),
         "final": None,
         "name": "splits",
         "client": SplitNemesis(),

@@ -360,7 +360,15 @@ def start_stop(t1, t2) -> SeqGen:
 
 
 class Mix(Generator):
-    """Uniform random choice between generators (generator.clj:337-349)."""
+    """Uniform random choice between generators (generator.clj:337-349).
+
+    The draw happens inside op(), so a slow member (e.g. a delay/
+    stagger wrapper that sleeps before yielding) blocks the calling
+    worker and starves its siblings' share of a bounded time window.
+    The reference has the same hazard — its mix also dispatches to the
+    chosen generator synchronously — and we keep the semantics for
+    parity; pace members with short intervals when mixing them under
+    time_limit."""
 
     def __init__(self, gens: Sequence):
         self.gens = [to_gen(g) for g in gens]

@@ -486,9 +486,11 @@ class TestSplitNemesis:
     def test_composed_during_flows_through_engine(self, tmp_path):
         """compose_nemeses' DURING generator must deliver both
         packages' (name, f) ops through core.run's nemesis worker.
-        Deterministic: the second package is a fast recorder with no
-        sleeps, so gen.mix draws both vocabularies many times within
-        the window."""
+        gen.mix runs a slow member's delay inside op() — the default
+        2 s split interval would leave only ~3 draws in the window —
+        so the splits package is built with a 0.1 s interval: worst
+        case (every draw lands on splits) still yields dozens of
+        draws, making a missing vocabulary astronomically unlikely."""
         from jepsen_tpu import nemesis as nem_mod
 
         seen = []
@@ -504,14 +506,14 @@ class TestSplitNemesis:
                  "client": Recorder(),
                  "clocks": False,
                  "fs": ("tick",)}
-        composed = cr.compose_nemeses([cr.splits(), ticks])
+        composed = cr.compose_nemeses([cr.splits(interval=0.1), ticks])
         assert composed["name"] == "splits+ticks"
 
-        t = _engine_test(tmp_path, "register", time_limit=5,
+        t = _engine_test(tmp_path, "register", time_limit=6,
                          ops_per_key=20, threads_per_key=2)
         t["nemesis"] = composed["client"]
         t["generator"] = gen.phases(gen.time_limit(
-            5, gen.nemesis(composed["during"],
+            6, gen.nemesis(composed["during"],
                            t["generator"])))
         result = core.run(t)
         history = result["history"]

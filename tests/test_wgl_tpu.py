@@ -113,6 +113,85 @@ class TestHostParity:
         assert abs(tr.steps - hr.steps) <= 1, (tr.steps, hr.steps)
 
 
+class TestDenseKernelParity:
+    """The dense (scatter-free, one-hot) step form must make the SAME
+    decisions as the scatter form and the host search: identical
+    verdicts and step counts. The forms are picked automatically by
+    lane count/pad size; here both are forced explicitly."""
+
+    @pytest.mark.parametrize("corrupt", [0.0, 0.35])
+    def test_dense_matches_scatter_and_host(self, corrupt):
+        hists = [
+            random_register_history(
+                n_process=4, n_ops=24, seed=100 + s, corrupt=corrupt
+            )
+            for s in range(12)
+        ]
+        entries_list = [make_entries(hh) for hh in hists]
+        m = CASRegister()
+        dense = wgl_tpu.analysis_batch(m, entries_list, dense=True)
+        scatter = wgl_tpu.analysis_batch(m, entries_list, dense=False)
+        for hh, es, dr, sr in zip(hists, entries_list, dense, scatter):
+            hr = wgl_host.analysis(m, es)
+            assert dr.valid == sr.valid == hr.valid, hh
+            assert dr.steps == sr.steps, (dr.steps, sr.steps)
+            assert abs(dr.steps - hr.steps) <= 1, (dr.steps, hr.steps)
+
+    def test_dense_queue_model(self):
+        hists = [random_queue_history(n_process=4, n_ops=30, seed=s)
+                 for s in range(6)]
+        entries_list = [make_entries(hh) for hh in hists]
+        qm = UnorderedQueue()
+        dense = wgl_tpu.analysis_batch(qm, entries_list, dense=True)
+        for es, dr in zip(entries_list, dense):
+            hr = wgl_host.analysis(qm, es)
+            assert dr.valid == hr.valid
+            assert abs(dr.steps - hr.steps) <= 1, (dr.steps, hr.steps)
+
+    def test_dense_respects_step_budget(self):
+        hist = random_register_history(n_process=5, n_ops=40, seed=7)
+        (r,) = wgl_tpu.analysis_batch(
+            CASRegister(), [make_entries(hist)], max_steps=1, dense=True)
+        assert r.valid == "unknown"
+
+    def test_auto_picks_dense_only_at_scale(self, monkeypatch):
+        """analysis_batch flips to the dense kernel at >=DENSE_MIN_LANES
+        lanes and <=DENSE_MAX_PAD pad entries — below that, scatter.
+        The threshold is lowered so the flip itself runs, and the
+        chosen form is observed at the kernel-builder boundary."""
+        chosen = []
+        real = wgl_tpu._kernel_for
+
+        def spy(jm, n_pad, n_state, cache_bits, max_steps, unroll,
+                dense=None):
+            chosen.append(dense)
+            return real(jm, n_pad, n_state, cache_bits, max_steps,
+                        unroll, dense)
+
+        monkeypatch.setattr(wgl_tpu, "_kernel_for", spy)
+        monkeypatch.setattr(wgl_tpu, "DENSE_MIN_LANES", 4)
+
+        below = [make_entries(random_register_history(
+            n_process=2, n_ops=6, seed=s)) for s in range(3)]
+        rs = wgl_tpu.analysis_batch(CASRegister(), below)
+        assert all(r.valid is True for r in rs)
+        assert chosen[-1] is False  # 3 lanes < threshold -> scatter
+
+        at = [make_entries(random_register_history(
+            n_process=2, n_ops=6, seed=s)) for s in range(4)]
+        rs = wgl_tpu.analysis_batch(CASRegister(), at)
+        assert all(r.valid is True for r in rs)
+        assert chosen[-1] is True  # 4 lanes >= threshold -> dense
+
+        # oversized pads never go dense, whatever the lane count
+        monkeypatch.setattr(wgl_tpu, "DENSE_MAX_PAD", 16)
+        big = [make_entries(random_register_history(
+            n_process=3, n_ops=40, seed=s)) for s in range(4)]
+        rs = wgl_tpu.analysis_batch(CASRegister(), big)
+        assert all(r.valid is True for r in rs)
+        assert chosen[-1] is False
+
+
 class TestBatchAndSharding:
     def test_mixed_sizes_bucket(self):
         hists = [
