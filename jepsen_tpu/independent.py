@@ -13,6 +13,7 @@ every subhistory), unwrapping matching tuples (independent.clj:234-245).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Iterable, NamedTuple
 
@@ -221,7 +222,35 @@ class IndependentChecker(Checker):
             self._write_artifacts(test, subdir, sub, r)
             return k, r
 
-        if self.processes and len(ks) > 1:
+        # Batched fast path: a sub-checker exposing check_batch (the
+        # linearizable checker) gets ALL per-key subhistories in one
+        # call, so its batch engines (native triage + the pallas lane
+        # kernel) see the whole key space at once instead of one
+        # launch per key. Any failure falls back to the per-key path,
+        # whose check_safe wrapper degrades per-key errors to unknown.
+        results = None
+        if len(ks) > 1 and hasattr(self.checker, "check_batch"):
+            payload = []
+            for k in ks:
+                sub = subhistory(k, history)
+                subdir = (list(opts.get("subdirectory") or [])
+                          + [DIR, str(k)])
+                payload.append((k, sub, {**opts, "subdirectory": subdir,
+                                         "history_key": k}))
+            try:
+                rs = self.checker.check_batch(
+                    test, [(sub, o) for _, sub, o in payload])
+            except Exception:  # noqa: BLE001 — degrade to per-key path
+                logging.getLogger("jepsen_tpu.independent").warning(
+                    "batched check failed; falling back to per-key",
+                    exc_info=True)
+            else:
+                results = {}
+                for (k, sub, o), r in zip(payload, rs):
+                    self._write_artifacts(test, o["subdirectory"], sub, r)
+                    results[k] = r
+
+        if results is None and self.processes and len(ks) > 1:
             # workers only use their own subhistory — shipping the full
             # test history (or other recorded bulk) to every worker
             # would serialize O(keys × |history|)
@@ -246,7 +275,7 @@ class IndependentChecker(Checker):
                 self._write_artifacts(test, payload[3]["subdirectory"],
                                       payload[2], r)
                 results[k] = r
-        else:
+        elif results is None:
             results = dict(bounded_pmap(check_key, ks))
         # Only definite falsifications are failures; "unknown" keys are
         # excluded, as in the reference (independent.clj:283-291, where

@@ -127,11 +127,11 @@ def _cas_register_step(state, f, v1, v2):
     is_write = f == 1
     is_cas = f == 2
     match = state == v1
-    ok = jnp.where(
-        is_read,
-        (v1 == NIL32) | match,
-        jnp.where(is_write, True, is_cas & match),
-    )
+    # pure boolean algebra (no where-with-literal-True): Mosaic's
+    # vector lowering rejects the i8->i1 truncation a splat True
+    # select produces, and the algebra is identical — f == -1 falls
+    # through every branch to ok=False
+    ok = (is_read & ((v1 == NIL32) | match)) | is_write | (is_cas & match)
     new_state = jnp.where(
         is_write, v1, jnp.where(is_cas & match, v2, state)
     )
@@ -150,7 +150,7 @@ def _register_step(state, f, v1, v2):
     # f: 0=read 1=write; f == -1 (unknown/malformed op) is never ok
     is_read = f == 0
     is_write = f == 1
-    ok = jnp.where(is_write, True, is_read & ((v1 == NIL32) | (state == v1)))
+    ok = is_write | (is_read & ((v1 == NIL32) | (state == v1)))
     new_state = jnp.where(is_write, v1, state)
     return new_state, ok
 
@@ -167,7 +167,7 @@ def _mutex_step(state, f, v1, v2):
     # f: 0=acquire 1=release; state: 0=free 1=held; f == -1 never ok
     is_acquire = f == 0
     is_release = f == 1
-    ok = jnp.where(is_acquire, state == 0, is_release & (state == 1))
+    ok = (is_acquire & (state == 0)) | (is_release & (state == 1))
     new_state = jnp.where(ok, jnp.where(is_acquire, 1, 0), state)
     return new_state, ok
 

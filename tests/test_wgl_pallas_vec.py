@@ -1,0 +1,143 @@
+"""Lane-vectorized single-kernel WGL search (ops/wgl_pallas_vec):
+verdict parity with the host search. Step counts are NOT asserted
+against the host — the kernel's direct-mapped full-compare cache
+prunes differently from the host's unbounded 8-probe memo (both are
+exact-key, hence sound) — but verdicts must match bit-for-bit.
+
+Runs in pallas interpret mode on the CPU test backend."""
+
+import pytest
+
+from jepsen_tpu.history import (
+    entries as make_entries,
+    index,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+)
+from jepsen_tpu.models import CASRegister, Mutex, Register, UnorderedQueue
+from jepsen_tpu.ops import wgl_host, wgl_pallas_vec
+
+from helpers import random_register_history
+
+
+def h(*ops):
+    return index(list(ops))
+
+
+def one(model, hist, **kw):
+    (r,) = wgl_pallas_vec.analysis_batch(model, [make_entries(hist)], **kw)
+    return r
+
+
+class TestLiteralHistories:
+    def test_sequential_ok(self):
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read"), ok_op(0, "read", 1),
+            invoke_op(0, "cas", (1, 2)), ok_op(0, "cas", (1, 2)),
+        )
+        assert one(CASRegister(), hist).valid is True
+
+    def test_bad_read(self):
+        r = one(CASRegister(), h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read"), ok_op(0, "read", 2),
+        ))
+        assert r.valid is False
+        assert r.op is not None  # host recovery supplies counterexample
+
+    def test_crash_semantics(self):
+        hist = h(
+            invoke_op(0, "write", 1), info_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        assert one(CASRegister(), hist).valid is True
+        hist2 = h(
+            invoke_op(0, "write", 1), fail_op(0, "write", 1),
+            invoke_op(1, "read"), ok_op(1, "read", 1),
+        )
+        assert one(CASRegister(), hist2).valid is False
+
+    def test_mutex(self):
+        good = h(
+            invoke_op(0, "acquire"), ok_op(0, "acquire"),
+            invoke_op(0, "release"), ok_op(0, "release"),
+        )
+        assert one(Mutex(), good).valid is True
+        bad = h(
+            invoke_op(0, "acquire"), ok_op(0, "acquire"),
+            invoke_op(1, "acquire"), ok_op(1, "acquire"),
+        )
+        assert one(Mutex(), bad).valid is False
+
+    def test_register_model(self):
+        hist = h(
+            invoke_op(0, "write", 3), ok_op(0, "write", 3),
+            invoke_op(1, "read"), ok_op(1, "read", 3),
+        )
+        assert one(Register(), hist).valid is True
+
+    def test_step_budget_unknown(self):
+        hist = random_register_history(n_process=4, n_ops=30, seed=9)
+        assert one(CASRegister(), hist, max_steps=1).valid == "unknown"
+
+    def test_queue_model_rejected(self):
+        with pytest.raises(ValueError, match="ineligible"):
+            wgl_pallas_vec.analysis_batch(
+                UnorderedQueue(),
+                [make_entries(h(invoke_op(0, "enqueue", 1),
+                                ok_op(0, "enqueue", 1)))])
+
+
+class TestHostVerdictParity:
+    @pytest.mark.parametrize("corrupt", [0.0, 0.3])
+    def test_randomized_parity(self, corrupt):
+        m = CASRegister()
+        hists = [
+            random_register_history(
+                n_process=4, n_ops=18, seed=300 + s, corrupt=corrupt
+            )
+            for s in range(20)
+        ]
+        entries_list = [make_entries(hh) for hh in hists]
+        rs = wgl_pallas_vec.analysis_batch(m, entries_list)
+        for hh, es, r in zip(hists, entries_list, rs):
+            hr = wgl_host.analysis(m, es)
+            assert r.valid == hr.valid, hh
+
+    def test_mixed_lane_sizes(self):
+        m = CASRegister()
+        hists = [
+            random_register_history(n_process=2, n_ops=4, seed=1),
+            random_register_history(n_process=4, n_ops=40, seed=2),
+            random_register_history(n_process=3, n_ops=12, seed=3,
+                                    corrupt=0.4),
+        ]
+        entries_list = [make_entries(hh) for hh in hists]
+        rs = wgl_pallas_vec.analysis_batch(m, entries_list)
+        for hh, es, r in zip(hists, entries_list, rs):
+            assert r.valid == wgl_host.analysis(m, es).valid, hh
+
+    def test_more_than_one_block(self):
+        """Lanes spill into a second 128-lane grid program; per-program
+        scratch re-init must isolate the blocks (a stale cache row from
+        block 0 wrongly matching in block 1 would corrupt verdicts)."""
+        m = CASRegister()
+        hists = [
+            random_register_history(
+                n_process=3, n_ops=10, seed=500 + s,
+                corrupt=0.3 if s % 4 == 0 else 0.0)
+            for s in range(130)
+        ]
+        entries_list = [make_entries(hh) for hh in hists]
+        rs = wgl_pallas_vec.analysis_batch(m, entries_list)
+        assert len(rs) == 130
+        for i, (es, r) in enumerate(zip(entries_list, rs)):
+            assert r.valid == wgl_host.analysis(m, es).valid, i
+
+    def test_empty_and_trivial(self):
+        assert wgl_pallas_vec.analysis_batch(CASRegister(), []) == []
+        r = one(CASRegister(), h(invoke_op(0, "read"), ok_op(0, "read")))
+        assert r.valid is True
