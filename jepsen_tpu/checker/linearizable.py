@@ -214,17 +214,27 @@ class Linearizable(Checker):
             results[i] = d
 
         algorithm = self.algorithm
+        batch_kw = {}
+        if self.time_limit is not None:
+            # same translation check() relies on (wgl_tpu.analysis):
+            # a while-loop kernel can't consult the wall clock, so the
+            # budget becomes steps via a conservative rate estimate
+            from ..ops import wgl_tpu as _wt
+
+            batch_kw["max_steps"] = max(
+                1000, int(self.time_limit * _wt.STEPS_PER_SEC_ESTIMATE))
         if algorithm == "pallas":
             from ..ops import wgl_pallas_vec
 
             for i, r in enumerate(
-                    wgl_pallas_vec.analysis_batch(model, ess)):
+                    wgl_pallas_vec.analysis_batch(model, ess, **batch_kw)):
                 finish(i, r)
             return results
         if algorithm == "tpu":
             from ..ops import wgl_tpu
 
-            for i, r in enumerate(wgl_tpu.analysis_batch(model, ess)):
+            for i, r in enumerate(
+                    wgl_tpu.analysis_batch(model, ess, **batch_kw)):
                 finish(i, r)
             return results
         if algorithm != "auto":
@@ -271,13 +281,15 @@ class Linearizable(Checker):
                 from ..ops import wgl_pallas_vec
 
                 for i, r in zip(rest,
-                                wgl_pallas_vec.analysis_batch(model, sub)):
+                                wgl_pallas_vec.analysis_batch(
+                                    model, sub, **batch_kw)):
                     finish(i, r)
             elif all(_tpu_eligible(model, es) for es in sub):
                 from ..ops import wgl_tpu
 
                 for i, r in zip(rest,
-                                wgl_tpu.analysis_batch(model, sub)):
+                                wgl_tpu.analysis_batch(model, sub,
+                                                       **batch_kw)):
                     finish(i, r)
             else:
                 for i in rest:

@@ -234,6 +234,118 @@ def kill_nemesis(db: "AerospikeDB", max_dead: int = 2) -> KillNemesis:
     return KillNemesis(db, max_dead)
 
 
+class PauseNemesis(nemesis.Nemesis):
+    """The reference's pause nemesis (aerospike/src/aerospike/
+    pause.clj:17-85): pause a bounded set of "master" nodes so
+    in-flight writes get trapped in their memory, then revive. Two
+    modes:
+
+      process — SIGSTOP the asd process; resume SIGCONTs it
+                (pause.clj:44-45,75). Targeted by pidfile rather than
+                killall so hermetic multi-node-on-one-host clusters
+                only freeze the victim's daemon.
+      net     — inject a large netem delay AND spawn a self-restoring
+                mini-daemon (`sleep N; tc qdisc del`) in the
+                background, because the delay also severs our own
+                control connection — the reference's "terrible hack"
+                (pause.clj:46-56). Resume is a no-op: the node heals
+                itself (pause.clj:76).
+
+    masters_limit bounds CONCURRENTLY paused nodes (pause.clj:25-27).
+    Speaks pause/resume and the shared start/stop aliases, so the
+    suites' standard nemesis generator drives it unchanged. A paused
+    node's client ops time out into :info (indeterminate) — the
+    history stays checkable because set/linearizability semantics
+    treat those as concurrent forever."""
+
+    def __init__(self, db: "AerospikeDB", mode: str = "process",
+                 masters_limit: int = 1, pause_delay: float = 30.0):
+        assert mode in ("process", "net"), mode
+        self.db = db
+        self.mode = mode
+        self.masters_limit = masters_limit
+        self.pause_delay = pause_delay
+        self.paused: set = set()
+
+    def _pidfile(self, test, node) -> str:
+        d = _suite.dir(test, node)
+        return f"{d}/{self.db.pid_name}"
+
+    def _pause(self, test, node) -> str:
+        remote = test["remote"]
+        if self.mode == "process":
+            remote.exec(
+                node,
+                ["bash", "-c",
+                 f"kill -STOP $(cat {self._pidfile(test, node)})"],
+                sudo=_suite.sudo(test))
+            return "paused"
+        delay_ms = int(self.pause_delay * 1000)
+        hold_s = int(self.pause_delay) + 1
+        # the (...)& subshell is the self-restoring mini-daemon: the
+        # delay severs our own control connection, so the restore MUST
+        # be backgrounded before this command returns (a bare
+        # `a; b; c &` would background only `c` and block in `b`)
+        remote.exec(
+            node,
+            ["nohup", "bash", "-c",
+             f"tc qdisc add dev eth0 root netem delay {delay_ms}ms 1ms "
+             f"distribution normal; "
+             f"(sleep {hold_s}; tc qdisc del dev eth0 root) "
+             "</dev/null >/dev/null 2>&1 &"],
+            sudo=_suite.sudo(test))
+        return "net-delayed"
+
+    def _resume(self, test, node) -> str:
+        if self.mode == "process":
+            test["remote"].exec(
+                node,
+                ["bash", "-c",
+                 f"kill -CONT $(cat {self._pidfile(test, node)})"],
+                sudo=_suite.sudo(test))
+            return "resumed"
+        return "self-restoring"  # pause.clj:76 — :net resume is nil
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f in ("pause", "start"):
+            budget = max(self.masters_limit - len(self.paused), 0)
+            if op.value:
+                # explicit targets are still bounded: masters_limit
+                # caps CONCURRENT pauses however the op arrives
+                targets = [n for n in op.value
+                           if n not in self.paused][:budget]
+            else:
+                candidates = [n for n in test["nodes"]
+                              if n not in self.paused]
+                targets = random.sample(
+                    candidates, min(budget, len(candidates)))
+            out = {}
+            for node in targets:
+                out[node] = self._pause(test, node)
+                self.paused.add(node)
+            return op.with_(type="info", value=out or "at-limit")
+        if op.f in ("resume", "stop"):
+            out = {}
+            for node in sorted(self.paused):
+                out[node] = self._resume(test, node)
+            self.paused.clear()
+            return op.with_(type="info", value=out or "none-paused")
+        raise ValueError(f"unknown nemesis op {op.f!r}")
+
+    def teardown(self, test):
+        for node in sorted(self.paused):
+            try:
+                self._resume(test, node)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        self.paused.clear()
+
+
+def pause_nemesis(db: "AerospikeDB", mode: str = "process",
+                  masters_limit: int = 1) -> PauseNemesis:
+    return PauseNemesis(db, mode, masters_limit)
+
+
 def r(test, process):
     return {"type": "invoke", "f": "read", "value": None}
 
@@ -350,7 +462,10 @@ def aerospike_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": wl["client"],
-            "nemesis": cmn.pick_nemesis(db_, opts),
+            "nemesis": cmn.pick_nemesis(db_, opts, extra={
+                "pause": lambda: PauseNemesis(db_, "process"),
+                "pause-net": lambda: PauseNemesis(db_, "net"),
+            }),
             "model": wl.get("model"),
             "generator": generator,
             "checker": wl["checker"],
@@ -360,7 +475,7 @@ def aerospike_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
-    cmn.nemesis_opt(p)
+    cmn.nemesis_opt(p, names=cmn.NEMESIS_NAMES + ("pause", "pause-net"))
     p.add_argument("--workload", default="cas-register",
                    choices=["cas-register", "counter", "set"])
     p.add_argument("--archive-url", dest="archive_url", default=None)

@@ -9,10 +9,11 @@ once instead of once per suite."""
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 
-from .. import db
+from .. import db, nemesis
 from .. import generator as gen
 from ..control import util as cu
 
@@ -301,6 +302,142 @@ def ready_gated_final(db, inner, opts: dict) -> AwaitReadyGen:
                          timeout=opts.get("ready_timeout", 30.0))
 
 
+class MultiDaemonDB(ArchiveDB):
+    """Shared machinery for suites whose nodes run SEVERAL daemons
+    (tidb's pd/tikv/tidb triple, mysql-cluster's mgmd/ndbd/mysqld):
+    per-role pid/log files, component start/stop/probe (the
+    ComponentKiller surface), a readiness poll that doubles as a
+    cross-node bring-up barrier, and ordered teardown. Subclasses
+    declare ROLES / ROLE_TAG / ROLE_BIN / STOP_ORDER and implement
+    role_args + role_port (and role_nodes when a role doesn't run
+    everywhere); setup order stays suite-specific."""
+
+    ROLES: tuple = ()
+    ROLE_TAG: dict = {}
+    ROLE_BIN: dict = {}
+    STOP_ORDER: tuple = ()
+
+    def role_nodes(self, test, role) -> list:
+        return list(test["nodes"])
+
+    def role_port(self, test, node, role) -> int:
+        raise NotImplementedError
+
+    def role_args(self, test, node, role) -> list:
+        raise NotImplementedError
+
+    def _role_files(self, test, node, role):
+        d = self.suite.dir(test, node)
+        tag = self.ROLE_TAG[role]
+        return f"{d}/{tag}.log", f"{d}/{tag}.pid"
+
+    def start_component(self, test, node, role) -> None:
+        d = self.suite.dir(test, node)
+        logf, pidf = self._role_files(test, node, role)
+        cu.start_daemon(
+            test["remote"], node, f"{d}/{self.ROLE_BIN[role]}",
+            *self.role_args(test, node, role),
+            logfile=logf, pidfile=pidf, chdir=d)
+
+    def stop_component(self, test, node, role) -> None:
+        _, pidf = self._role_files(test, node, role)
+        cu.stop_daemon(test["remote"], node, pidf)
+
+    def component_running(self, test, node, role):
+        _, pidf = self._role_files(test, node, role)
+        return cu.daemon_running(test["remote"], node, pidf)
+
+    def _await_ports(self, test, role, timeout) -> None:
+        """Poll every hosting node's `role` port from this node's
+        setup — readiness-gating replaces the reference's synchronize
+        + fixed sleeps (setup runs on all nodes in parallel, so this
+        is an effective cross-node barrier)."""
+        deadline = time.monotonic() + timeout
+        pending = list(self.role_nodes(test, role))
+        while pending:
+            pending = [
+                n for n in pending
+                if not self._port_open(self.suite.host(test, n),
+                                       self.role_port(test, n, role))
+            ]
+            if not pending:
+                return
+            if time.monotonic() > deadline:
+                raise db.SetupFailed(
+                    f"{self.suite.name} {role} never ready on {pending}")
+            time.sleep(0.05)
+
+    @staticmethod
+    def _port_open(host, port) -> bool:
+        import socket
+
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        except OSError:
+            return False
+
+    def teardown(self, test, node) -> None:
+        remote = test["remote"]
+        d = self.suite.dir(test, node)
+        for role in self.STOP_ORDER:
+            _, pidf = self._role_files(test, node, role)
+            cu.stop_daemon(remote, node, pidf)
+        remote.exec(node, ["rm", "-rf", d], sudo=self.suite.sudo(test),
+                    check=False)
+
+    def log_files(self, test, node) -> list:
+        d = self.suite.dir(test, node)
+        return [f"{d}/{self.ROLE_TAG[r]}.log" for r in self.ROLES]
+
+
+class ComponentKiller(nemesis.Nemesis):
+    """Kill one role's daemon on a random node; stop revives every
+    downed instance of that role. Speaks the partitioner's start/stop
+    op convention so the suites' shared nemesis generator drives it
+    unchanged. For multi-daemon DBs (tidb's pd/tikv/tidb triple,
+    mysql-cluster's mgmd/ndbd/mysqld roles): faults hit one component
+    while the node's other daemons keep serving. The DB must expose
+    start_component/stop_component(test, node, role) and may expose
+    `role_nodes(test, role)` to bound which nodes host the role."""
+
+    def __init__(self, db, role: str):
+        self.db = db
+        self.role = role
+        self.downed: set = set()
+
+    def _hosts(self, test) -> list:
+        fn = getattr(self.db, "role_nodes", None)
+        return list(fn(test, self.role)) if fn else list(test["nodes"])
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            candidates = [n for n in self._hosts(test)
+                          if n not in self.downed]
+            if not candidates:
+                return op.with_(type="info", value="all-down")
+            node = random.choice(candidates)
+            self.db.stop_component(test, node, self.role)
+            self.downed.add(node)
+            return op.with_(type="info", value=[self.role, "killed", node])
+        if op.f == "stop":
+            revived = sorted(self.downed)
+            for node in revived:
+                self.db.start_component(test, node, self.role)
+            self.downed.clear()
+            return op.with_(type="info",
+                            value=[self.role, "restarted", revived])
+        raise ValueError(f"unknown nemesis op {op.f!r}")
+
+    def teardown(self, test):
+        for node in sorted(self.downed):
+            try:
+                self.db.start_component(test, node, self.role)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        self.downed.clear()
+
+
 def standard_nemeses(db) -> dict:
     """The named-nemesis registry the per-DB runners share (the
     cockroach/tidb registries' common core, nemesis.clj:110-144):
@@ -328,11 +465,15 @@ NEMESIS_NAMES = ("none", "parts", "majority-ring", "start-stop",
 PARTITION_NEMESIS_NAMES = ("none", "parts", "majority-ring")
 
 
-def pick_nemesis(db, opts: dict, default: str = "parts"):
+def pick_nemesis(db, opts: dict, default: str = "parts", extra=None):
     """Resolve the suite's nemesis from the shared --nemesis option
-    (the cockroach/tidb CLI surface, generalized)."""
+    (the cockroach/tidb CLI surface, generalized). `extra` merges
+    suite-specific entries (e.g. component killers for multi-daemon
+    DBs) over the standard registry."""
     name = opts.get("nemesis") or default
     registry = standard_nemeses(db)
+    if extra:
+        registry.update(extra)
     if name not in registry:
         raise ValueError(
             f"nemesis {name!r} not available for this suite "

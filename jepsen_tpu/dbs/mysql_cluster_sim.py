@@ -1,0 +1,28 @@
+"""Hermetic MySQL Cluster (NDB) archive: the mgmd/ndbd/mysqld ROLES.
+
+The real deployment runs three process types with distinct node-id
+bands and data dirs (/root/reference/mysql-cluster/src/jepsen/
+mysql_cluster.clj:53-57,140-168): ndb_mgmd (management, port 1186),
+ndbd (storage, on the first four nodes), and mysqld (SQL, 3306). The
+archive mirrors that shape: `ndb_mgmd` and `ndbd` are role
+placeholders (dbs/role_sim — real pids, ports, logs; kill/restart
+targets), `mysqld` is the MySQL-protocol sim. All three share the same
+state file, standing in for NDB's replicated storage.
+"""
+
+from __future__ import annotations
+
+from .simbase import build_multi_sim_archive
+
+
+def build_archive(dest: str, data_path: str, mean_latency: float = 0.0,
+                  python: str | None = None) -> str:
+    return build_multi_sim_archive(
+        dest, "mysql-cluster-sim",
+        {
+            "ndb_mgmd": "jepsen_tpu.dbs.role_sim",
+            "ndbd": "jepsen_tpu.dbs.role_sim",
+            "mysqld": "jepsen_tpu.dbs.mysql_sim",
+        },
+        data_path, mean_latency=mean_latency, python=python,
+    )

@@ -25,6 +25,22 @@ from .common import once as _once, shared_flag as _shared_flag
 log = logging.getLogger("jepsen_tpu.dbs.mysql_common")
 
 
+def probe_mysql_ready(suite, test, node) -> bool:
+    """Shared readiness probe: the SQL port answers a trivial query
+    (a server mid-startup can speak garbage; callers keep polling)."""
+    try:
+        conn = mp.MySqlConn(suite.host(test, node),
+                            suite.port(test, node),
+                            connect_timeout=2.0, timeout=2.0)
+        try:
+            conn.query("select 1")
+            return True
+        finally:
+            conn.close()
+    except (mp.MySqlError, mp.MySqlProtocolError):
+        return False
+
+
 def conn_wrapper(suite, test, node, user="jepsen", password="",
                  database="jepsen"):
     host, port = suite.host(test, node), suite.port(test, node)
@@ -331,9 +347,16 @@ def bank_diff_transfer():
 
 def make_sql_suite(name: str, default_port: int, binary: str,
                    daemon_args_fn, workload_names: tuple,
-                   display_name: str | None = None):
+                   display_name: str | None = None,
+                   db_cls=None,
+                   extra_nemeses=None,
+                   extra_nemesis_names: tuple = ()):
     """Build (suite_cfg, DBClass, workloads_fn, test_fn, opt_spec) for a
-    MySQL-protocol suite."""
+    MySQL-protocol suite. db_cls overrides the default single-daemon
+    ArchiveDB (tidb's triple, mysql-cluster's role split);
+    extra_nemeses(db) -> dict merges suite-specific nemesis entries
+    (component killers) into the shared registry, and
+    extra_nemesis_names exposes them on the --nemesis flag."""
     from .. import checker as checker_mod
     from .. import models, osdist
     from .common import ArchiveDB, SuiteCfg
@@ -353,20 +376,13 @@ def make_sql_suite(name: str, default_port: int, binary: str,
             return daemon_args_fn(suite, test, node)
 
         def probe_ready(self, test, node):
-            try:
-                conn = mp.MySqlConn(suite.host(test, node),
-                                    suite.port(test, node),
-                                    connect_timeout=2.0, timeout=2.0)
-                try:
-                    conn.query("select 1")
-                    return True
-                finally:
-                    conn.close()
-            except (mp.MySqlError, mp.MySqlProtocolError):
-                # a server mid-startup can speak garbage; keep polling
-                return False
+            return probe_mysql_ready(suite, test, node)
 
     DB.__name__ = f"{name.title().replace('-', '')}DB"
+    if db_cls is not None:
+        # factory form: db_cls(suite) -> class, so multi-daemon DBs
+        # close over the suite cfg built here
+        DB = db_cls(suite)  # noqa: F811 — deliberate override
 
     def workloads(opts: dict):
         import itertools
@@ -446,10 +462,13 @@ def make_sql_suite(name: str, default_port: int, binary: str,
         wl_name = opts.get("workload", workload_names[0])
         wl = workloads(opts)[wl_name]
         db = DB(archive_url=opts.get("archive_url"))
-        nem_client = pick_nemesis(db, opts)
+        nem_client = pick_nemesis(
+            db, opts,
+            extra=extra_nemeses(db) if extra_nemeses else None)
+        dt = opts.get("nemesis_interval", 10)
         generator = gen.time_limit(
             opts.get("time_limit", 60),
-            gen.nemesis(gen.start_stop(10, 10), wl["during"]),
+            gen.nemesis(gen.start_stop(dt, dt), wl["during"]),
         )
         phases = [generator,
                   gen.nemesis(gen.once({"type": "info", "f": "stop"}))]
@@ -481,11 +500,11 @@ def make_sql_suite(name: str, default_port: int, binary: str,
         return test
 
     def opt_spec(p) -> None:
-        from .common import nemesis_opt
+        from .common import NEMESIS_NAMES, nemesis_opt
 
         p.add_argument("--workload", default=workload_names[0],
                        choices=sorted(workload_names))
-        nemesis_opt(p)
+        nemesis_opt(p, names=NEMESIS_NAMES + tuple(extra_nemesis_names))
         p.add_argument("--archive-url", dest="archive_url", default=None)
         p.add_argument("--accounts", type=int, default=5)
         p.add_argument("--starting-balance", dest="starting_balance",

@@ -166,7 +166,10 @@ def parse_args(argv):
     p.add_argument("--ndb-connectstring", default=None)
     p.add_argument("--store", default=None)
     p.add_argument("--path", default=None)
-    return p.parse_args(argv)
+    # the real mysqld accepts a rich flag surface (--ndb-nodeid,
+    # --datadir, ...); unknown flags are ignored, not fatal
+    args, _unknown = p.parse_known_args(argv)
+    return args
 
 
 def serve(argv=None) -> None:

@@ -114,26 +114,41 @@ def build_sim_archive(dest: str, module: str, binary: str, arcname: str,
                       python: str | None = None) -> str:
     """Build a tar.gz whose `binary` is a script launching `module`
     (a jepsen_tpu.dbs simulator) with a shared state file."""
+    return build_multi_sim_archive(
+        dest, arcname, {binary: module}, data_path,
+        mean_latency=mean_latency, python=python)
+
+
+def build_multi_sim_archive(dest: str, arcname: str, binaries: dict,
+                            data_path: str, mean_latency: float = 0.0,
+                            python: str | None = None) -> str:
+    """Build a tar.gz containing SEVERAL launcher scripts — the shape
+    of multi-daemon systems (tidb's pd/tikv/tidb triple, mysql
+    cluster's mgmd/ndbd/mysqld roles). `binaries` maps binary name ->
+    jepsen_tpu.dbs module; every script shares the same state file so
+    the role daemons and the SQL daemon see one cluster."""
     import tarfile
 
     python = python or sys.executable
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    script = (
-        "#!/bin/bash\n"
-        f"export PYTHONPATH={shlex.quote(repo_root)}:$PYTHONPATH\n"
-        f"exec {shlex.quote(python)} -m {module} "
-        f"--data {shlex.quote(data_path)} --mean-latency {mean_latency} "
-        "\"$@\"\n"
-    )
     os.makedirs(os.path.dirname(os.path.abspath(dest)) or ".", exist_ok=True)
     with tempfile.TemporaryDirectory() as td:
         top = os.path.join(td, arcname)
         os.makedirs(top)
-        bin_path = os.path.join(top, binary)
-        with open(bin_path, "w") as f:
-            f.write(script)
-        os.chmod(bin_path, 0o755)
+        for binary, module in binaries.items():
+            script = (
+                "#!/bin/bash\n"
+                f"export PYTHONPATH={shlex.quote(repo_root)}:$PYTHONPATH\n"
+                f"exec {shlex.quote(python)} -m {module} "
+                f"--data {shlex.quote(data_path)} "
+                f"--mean-latency {mean_latency} "
+                "\"$@\"\n"
+            )
+            bin_path = os.path.join(top, binary)
+            with open(bin_path, "w") as f:
+                f.write(script)
+            os.chmod(bin_path, 0o755)
         with tarfile.open(dest, "w:gz") as tar:
             tar.add(top, arcname=arcname)
     return dest

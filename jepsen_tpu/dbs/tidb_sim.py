@@ -1,0 +1,27 @@
+"""Hermetic tidb cluster archive: the pd/tikv/tidb TRIPLE.
+
+The real deployment runs three daemons per node with ordered bring-up
+(/root/reference/tidb/src/tidb/db.clj:14-223: pd quorum, then tikv,
+then tidb). The archive mirrors that shape: `pd-server` and
+`tikv-server` are role placeholders (dbs/role_sim — real pids, ports,
+logs; kill/restart targets), `tidb-server` is the MySQL-protocol sim
+(dbs/mysql_sim) that actually serves SQL. All three share the same
+state file, standing in for tikv's replicated store.
+"""
+
+from __future__ import annotations
+
+from .simbase import build_multi_sim_archive
+
+
+def build_archive(dest: str, data_path: str, mean_latency: float = 0.0,
+                  python: str | None = None) -> str:
+    return build_multi_sim_archive(
+        dest, "tidb-sim",
+        {
+            "pd-server": "jepsen_tpu.dbs.role_sim",
+            "tikv-server": "jepsen_tpu.dbs.role_sim",
+            "tidb-server": "jepsen_tpu.dbs.mysql_sim",
+        },
+        data_path, mean_latency=mean_latency, python=python,
+    )

@@ -585,23 +585,24 @@ class TestCrateDirtyRead:
 class TestGenericArchiveKillNemesis:
     def test_any_archive_suite_gets_kill_restart(self, tmp_path):
         """The generic bounded killer works on any ArchiveDB suite —
-        here, tidb's mysql-protocol sim cluster."""
-        from jepsen_tpu.dbs import mysql_sim, tidb
+        here, galera's mysql-protocol sim cluster (tidb moved to a
+        multi-daemon DB with its own component killers)."""
+        from jepsen_tpu.dbs import galera, mysql_sim
         from jepsen_tpu.dbs.common import archive_kill_nemesis
 
         nodes = ["n1", "n2", "n3"]
         remote = LocalRemote(root=str(tmp_path / "nodes"))
-        archive = str(tmp_path / "tidb.tar.gz")
+        archive = str(tmp_path / "galera.tar.gz")
         mysql_sim.build_archive(archive, str(tmp_path / "s" / "m.json"),
-                                binary="tidb-server")
+                                binary="mysqld")
         cfg = {
             "addr_fn": lambda n: "127.0.0.1",
             "ports": {n: free_port() for n in nodes},
             "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
             "sudo": None,
         }
-        db = tidb.TidbDB(archive_url=f"file://{archive}")
-        test = {"remote": remote, "nodes": nodes, "tidb": cfg}
+        db = galera.GaleraDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "galera": cfg}
         for n in nodes:
             db.setup(test, n)
         try:
@@ -622,3 +623,168 @@ class TestGenericArchiveKillNemesis:
         finally:
             for n in nodes:
                 db.teardown(test, n)
+
+
+class TestAerospikePause:
+    """The pause nemesis (aerospike/pause.clj:17-85): SIGSTOP a
+    bounded set of masters so their in-flight ops go indeterminate,
+    then revive; :net mode self-restores via a tc mini-daemon."""
+
+    def _cluster(self, tmp_path, nodes=("n1", "n2")):
+        nodes = list(nodes)
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "as.tar.gz")
+        aerospike_sim.build_archive(archive, str(tmp_path / "s" / "a.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+        }
+        db = aerospike.AerospikeDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "aerospike": cfg}
+        for n in nodes:
+            db.setup(test, n)
+        return db, test, nodes
+
+    def test_paused_masters_ops_go_info_then_revive(self, tmp_path):
+        """VERDICT r2 item 7's done-bar: a paused master's ops are
+        indeterminate (:info) while other nodes serve; resume brings
+        it back and the history stays checkable."""
+        from jepsen_tpu import checker as checker_mod
+        from jepsen_tpu.history import index
+
+        db, test, nodes = self._cluster(tmp_path)
+        try:
+            nem = aerospike.PauseNemesis(db, "process", masters_limit=1)
+            out = nem.invoke(test, Op("nemesis", "invoke", "pause", None))
+            assert isinstance(out.value, dict) and len(out.value) == 1
+            victim = next(iter(out.value))
+            assert out.value[victim] == "paused"
+            live = next(n for n in nodes if n != victim)
+
+            hist = []
+            c = aerospike.SetClient()
+            # ops against the FROZEN daemon: connect may refuse or the
+            # protocol may hang to timeout — either way the completion
+            # must be indeterminate/unknown, never :ok
+            try:
+                cv = c.open(test, victim)
+                cv.conn.timeout = 1.0
+                cv.conn.sock.settimeout(1.0)
+                r = cv.invoke(test, Op(0, "invoke", "add",
+                                       __import__("jepsen_tpu").independent
+                                       .tuple_(0, 1)))
+                assert r.type == "info", r
+                hist += [Op(0, "invoke", "add", r.value, index=0),
+                         r.with_(index=1)]
+            except Exception:
+                # SIGSTOP before accept(): open itself fails — the
+                # engine records that as a crashed (:info) process,
+                # same indeterminacy
+                pass
+            # the OTHER node still serves
+            cl = aerospike.SetClient().open(test, live)
+            base = len(hist)
+            inv = Op(1, "invoke", "add",
+                     __import__("jepsen_tpu").independent.tuple_(0, 2),
+                     index=base)
+            ok = cl.invoke(test, inv)
+            assert ok.type == "ok", ok
+            hist += [inv, ok.with_(index=base + 1)]
+
+            out = nem.invoke(test, Op("nemesis", "invoke", "resume", None))
+            assert out.value == {victim: "resumed"}
+            assert nem.paused == set()
+            # revived node answers again
+            assert db.probe_ready(test, victim)
+
+            # the (possibly crash-bearing) history stays checkable
+            rd_i = Op(2, "invoke", "read",
+                      __import__("jepsen_tpu").independent.tuple_(0, None),
+                      index=len(hist))
+            rd = cl.invoke(test, rd_i)
+            hist += [rd_i, rd.with_(index=len(hist) + 1)]
+            from jepsen_tpu import independent as indep
+
+            res = indep.checker(checker_mod.set_checker()).check(
+                {}, index(hist), {})
+            assert res["valid"] in (True, "unknown"), res
+        finally:
+            nem.teardown(test)
+            for n in nodes:
+                db.teardown(test, n)
+
+    def test_masters_limit_bounds_concurrent_pauses(self, tmp_path):
+        db, test, nodes = self._cluster(tmp_path, nodes=("n1", "n2", "n3"))
+        try:
+            nem = aerospike.PauseNemesis(db, "process", masters_limit=1)
+            out1 = nem.invoke(test, Op("nemesis", "invoke", "pause", None))
+            assert len(out1.value) == 1
+            out2 = nem.invoke(test, Op("nemesis", "invoke", "pause", None))
+            assert out2.value == "at-limit"
+            assert len(nem.paused) == 1
+        finally:
+            nem.teardown(test)
+            for n in nodes:
+                db.teardown(test, n)
+
+    def test_net_mode_spawns_self_restoring_daemon(self):
+        """:net mode must inject the delay AND background a
+        sleep-then-del restore (pause.clj:46-56) — resume is a no-op."""
+        calls = []
+
+        class FakeRemote:
+            def exec(self, node, argv, sudo=None, check=True):
+                calls.append((node, argv))
+
+        db = aerospike.AerospikeDB(archive_url="file:///x")
+        nem = aerospike.PauseNemesis(db, "net", masters_limit=2,
+                                     pause_delay=30.0)
+        test = {"remote": FakeRemote(), "nodes": ["n1", "n2"],
+                "aerospike": {"sudo": None}}
+        out = nem.invoke(test, Op("nemesis", "invoke", "pause",
+                                  ["n1", "n2"]))
+        assert out.value == {"n1": "net-delayed", "n2": "net-delayed"}
+        for _node, argv in calls:
+            script = argv[-1]
+            assert "tc qdisc add dev eth0 root netem delay 30000ms" in script
+            # the restore must run in a BACKGROUNDED subshell — a bare
+            # `a; b; c &` would background only `c` and block in the
+            # sleep over a connection we just delayed by 30s
+            assert "(sleep 31; tc qdisc del dev eth0 root)" in script
+            assert argv[0] == "nohup" and script.rstrip().endswith("&")
+        n_pause_calls = len(calls)
+        out = nem.invoke(test, Op("nemesis", "invoke", "resume", None))
+        assert out.value == {"n1": "self-restoring", "n2": "self-restoring"}
+        assert len(calls) == n_pause_calls  # resume issued no commands
+
+    def test_pause_nemesis_on_cli_surface(self):
+        import argparse
+
+        p = argparse.ArgumentParser()
+        aerospike._opt_spec(p)
+        args = p.parse_args(["--nemesis", "pause"])
+        assert args.nemesis == "pause"
+        t = aerospike.aerospike_test({
+            "workload": "set", "nodes": ["a"], "nemesis": "pause-net",
+            "time_limit": 5})
+        assert isinstance(t["nemesis"], aerospike.PauseNemesis)
+        assert t["nemesis"].mode == "net"
+
+    def test_masters_limit_bounds_explicit_targets(self):
+        """An explicit target list cannot exceed masters_limit either
+        (the budget applies however the op arrives)."""
+        calls = []
+
+        class FakeRemote:
+            def exec(self, node, argv, sudo=None, check=True):
+                calls.append(node)
+
+        db = aerospike.AerospikeDB(archive_url="file:///x")
+        nem = aerospike.PauseNemesis(db, "net", masters_limit=1)
+        test = {"remote": FakeRemote(), "nodes": ["n1", "n2", "n3"],
+                "aerospike": {"sudo": None}}
+        out = nem.invoke(test, Op("nemesis", "invoke", "pause",
+                                  ["n1", "n2", "n3"]))
+        assert len(out.value) == 1 and len(nem.paused) == 1

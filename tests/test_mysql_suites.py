@@ -156,10 +156,41 @@ def _sim_cluster(tmp_path, nodes, binary):
     return remote, archive, cfg
 
 
+def _parallel_setup(db, test, nodes):
+    """Run setup on every node concurrently, like the engine's
+    with_db does — the triple's bring-up gates each stage on every
+    node's ports, so sequential setup would deadlock at stage one."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(len(nodes)) as ex:
+        for f in [ex.submit(db.setup, test, n) for n in nodes]:
+            f.result()
+
+
+def _tidb_cluster(tmp_path, nodes, binary="tidb"):
+    """The triple needs per-node pd/tikv/peer ports too — all nodes
+    share 127.0.0.1 under LocalRemote."""
+    from jepsen_tpu.dbs import tidb_sim
+
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "tidb.tar.gz")
+    tidb_sim.build_archive(archive, str(tmp_path / "s" / "m.json"))
+    cfg = {
+        "addr_fn": lambda n: "127.0.0.1",
+        "ports": {n: free_port() for n in nodes},
+        "pd_ports": {n: free_port() for n in nodes},
+        "pd_peer_ports": {n: free_port() for n in nodes},
+        "tikv_ports": {n: free_port() for n in nodes},
+        "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+        "sudo": None,
+    }
+    return remote, archive, cfg
+
+
 def _run_suite(tmp_path, module, test_fn, suite, workload, binary,
-               **extra):
+               sim_cluster=_sim_cluster, keep_nemesis=False, **extra):
     nodes = ["n1", "n2"]
-    remote, archive, cfg = _sim_cluster(tmp_path, nodes, binary)
+    remote, archive, cfg = sim_cluster(tmp_path, nodes, binary)
     t = test_fn({
         "workload": workload,
         "nodes": nodes,
@@ -174,7 +205,8 @@ def _run_suite(tmp_path, module, test_fn, suite, workload, binary,
     })
     t["os"] = None
     t["net"] = None
-    t["nemesis"] = nemesis.noop
+    if not keep_nemesis:
+        t["nemesis"] = nemesis.noop
     return core.run(t)
 
 
@@ -192,13 +224,32 @@ class TestFullRuns:
     def test_mysql_cluster_bank(self, tmp_path):
         result = _run_suite(
             tmp_path, mysql_cluster, mysql_cluster.mysql_cluster_test,
-            mysql_cluster.suite, "bank", "mysqld")
+            mysql_cluster.suite, "bank", "mysqld",
+            sim_cluster=_ndb_cluster)
         assert result["results"]["valid"] is True, result["results"]
 
     def test_tidb_register(self, tmp_path):
         result = _run_suite(tmp_path, tidb, tidb.tidb_test, tidb.suite,
-                            "register", "tidb-server")
+                            "register", "tidb-server",
+                            sim_cluster=_tidb_cluster)
         assert result["results"]["valid"] is True, result["results"]
+
+    def test_tidb_register_under_tikv_kills(self, tmp_path):
+        """The triple's point: a kill-tikv nemesis takes storage
+        daemons down and back mid-run while tidb keeps serving — the
+        run must stay valid and the tikv component ops must appear."""
+        result = _run_suite(tmp_path, tidb, tidb.tidb_test, tidb.suite,
+                            "register", "tidb-server",
+                            sim_cluster=_tidb_cluster,
+                            keep_nemesis=True,
+                            nemesis="kill-tikv",
+                            nemesis_interval=0.8)
+        assert result["results"]["valid"] is True, result["results"]
+        nem_ops = [o for o in result["history"]
+                   if o.process == "nemesis" and o.type == "info"
+                   and isinstance(o.value, list)
+                   and o.value and o.value[0] == "tikv"]
+        assert any(o.value[1] == "killed" for o in nem_ops), nem_ops
 
 
 class TestBundles:
@@ -234,20 +285,10 @@ class TestStandardNemeses:
         from jepsen_tpu.dbs.common import StartKillNemesis
 
         nodes = ["n1", "n2", "n3"]
-        remote = LocalRemote(root=str(tmp_path / "nodes"))
-        archive = str(tmp_path / "tidb.tar.gz")
-        mysql_sim.build_archive(archive, str(tmp_path / "s" / "m.json"),
-                                binary="tidb-server")
-        cfg = {
-            "addr_fn": lambda n: "127.0.0.1",
-            "ports": {n: free_port() for n in nodes},
-            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
-            "sudo": None,
-        }
+        remote, archive, cfg = _tidb_cluster(tmp_path, nodes)
         db = tidb.TidbDB(archive_url=f"file://{archive}")
         test = {"remote": remote, "nodes": nodes, "tidb": cfg}
-        for n in nodes:
-            db.setup(test, n)
+        _parallel_setup(db, test, nodes)
         try:
             nem = StartKillNemesis(db, n=1)
             out = nem.invoke(test, Op("nemesis", "invoke", "start", None))
@@ -270,3 +311,161 @@ class TestStandardNemeses:
         from jepsen_tpu.dbs.common import StartKillNemesis
 
         assert isinstance(t["nemesis"], StartKillNemesis)
+
+
+class TestTidbTriple:
+    """The pd/tikv/tidb triple (tidb/db.clj:14-223): ordered bring-up,
+    per-component pids/logs, and component-targeted kills that leave
+    the node's SQL daemon serving."""
+
+    def _up(self, tmp_path, nodes=("n1", "n2")):
+        nodes = list(nodes)
+        remote, archive, cfg = _tidb_cluster(tmp_path, nodes)
+        db = tidb.TidbDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "tidb": cfg}
+        _parallel_setup(db, test, nodes)
+        return db, test, nodes
+
+    def test_setup_brings_up_three_components(self, tmp_path):
+        db, test, nodes = self._up(tmp_path)
+        try:
+            for n in nodes:
+                for role in tidb.ROLES:
+                    assert db.component_running(test, n, role), (n, role)
+            # three distinct logs per node (db.clj's pd/kv/db logfiles)
+            logs = db.log_files(test, nodes[0])
+            assert len(logs) == 3 and len(set(logs)) == 3
+        finally:
+            for n in nodes:
+                db.teardown(test, n)
+
+    def test_tikv_killed_while_tidb_lives(self, tmp_path):
+        """Kill the storage daemon on one node: its tidb-server must
+        stay up and keep answering SQL (replicated reads)."""
+        db, test, nodes = self._up(tmp_path)
+        try:
+            nem = tidb.ComponentKiller(db, "tikv")
+            out = nem.invoke(test, Op("nemesis", "invoke", "start", None))
+            assert out.value[0:2] == ["tikv", "killed"]
+            victim = out.value[2]
+            assert not db.component_running(test, victim, "tikv")
+            assert db.component_running(test, victim, "tidb")
+            assert db.component_running(test, victim, "pd")
+            # SQL still served on the victim node
+            assert db.probe_ready(test, victim)
+            out = nem.invoke(test, Op("nemesis", "invoke", "stop", None))
+            assert out.value[0:2] == ["tikv", "restarted"]
+            assert db.component_running(test, victim, "tikv")
+        finally:
+            for n in nodes:
+                db.teardown(test, n)
+
+    def test_teardown_stops_all_components(self, tmp_path):
+        db, test, nodes = self._up(tmp_path)
+        for n in nodes:
+            db.teardown(test, n)
+        for n in nodes:
+            for role in tidb.ROLES:
+                assert not db.component_running(test, n, role), (n, role)
+
+    def test_component_nemeses_registered(self):
+        t = tidb.tidb_test({"workload": "register", "nodes": ["a"],
+                            "nemesis": "kill-pd", "time_limit": 5})
+        assert isinstance(t["nemesis"], tidb.ComponentKiller)
+        assert t["nemesis"].role == "pd"
+
+
+def _ndb_cluster(tmp_path, nodes, binary="mysqld"):
+    """The NDB role split needs per-node mgmd/ndbd ports — all nodes
+    share 127.0.0.1 under LocalRemote."""
+    from jepsen_tpu.dbs import mysql_cluster_sim
+
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    archive = str(tmp_path / "ndb.tar.gz")
+    mysql_cluster_sim.build_archive(archive, str(tmp_path / "s" / "m.json"))
+    cfg = {
+        "addr_fn": lambda n: "127.0.0.1",
+        "ports": {n: free_port() for n in nodes},
+        "mgmd_ports": {n: free_port() for n in nodes},
+        "ndbd_ports": {n: free_port() for n in nodes},
+        "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+        "sudo": None,
+    }
+    return remote, archive, cfg
+
+
+class TestNdbRoles:
+    """The mgmd/ndbd/mysqld role split (mysql_cluster.clj:53-207):
+    node-id bands, ndbd on the first four nodes only, ordered
+    bring-up, and role-targeted kills."""
+
+    def _up(self, tmp_path, nodes):
+        remote, archive, cfg = _ndb_cluster(tmp_path, nodes)
+        db = mysql_cluster.MysqlClusterDB(archive_url=f"file://{archive}")
+        test = {"remote": remote, "nodes": nodes, "mysql-cluster": cfg}
+        _parallel_setup(db, test, nodes)
+        return db, test
+
+    def test_node_id_bands(self):
+        db = mysql_cluster.MysqlClusterDB(archive_url="file:///x")
+        t = {"nodes": ["n1", "n2", "n3"]}
+        assert db.node_id(t, "n1", "mgmd") == 1
+        assert db.node_id(t, "n2", "ndbd") == 12
+        assert db.node_id(t, "n3", "mysqld") == 23
+
+    def test_ndbd_only_on_first_four(self):
+        db = mysql_cluster.MysqlClusterDB(archive_url="file:///x")
+        t = {"nodes": [f"n{i}" for i in range(1, 6)]}
+        assert db.role_nodes(t, "ndbd") == ["n1", "n2", "n3", "n4"]
+        assert db.role_nodes(t, "mysqld") == t["nodes"]
+
+    def test_ndbd_killed_while_mysqld_survives(self, tmp_path):
+        """VERDICT r2 item 6's done-bar: kill a storage daemon; the
+        node's mysqld must keep serving SQL."""
+        nodes = ["n1", "n2"]
+        db, test = self._up(tmp_path, nodes)
+        try:
+            for n in nodes:
+                for role in mysql_cluster.ROLES:
+                    assert db.component_running(test, n, role), (n, role)
+            nem = mysql_cluster.ComponentKiller(db, "ndbd")
+            out = nem.invoke(test, Op("nemesis", "invoke", "start", None))
+            assert out.value[0:2] == ["ndbd", "killed"]
+            victim = out.value[2]
+            assert not db.component_running(test, victim, "ndbd")
+            assert db.component_running(test, victim, "mysqld")
+            assert db.component_running(test, victim, "mgmd")
+            assert db.probe_ready(test, victim)  # SQL still answers
+            out = nem.invoke(test, Op("nemesis", "invoke", "stop", None))
+            assert db.component_running(test, victim, "ndbd")
+        finally:
+            for n in nodes:
+                db.teardown(test, n)
+
+    def test_killer_respects_role_hosting(self, tmp_path):
+        """kill-ndbd must only ever pick nodes that HOST an ndbd (the
+        first four) — on a 5-node cluster n5 is never a victim."""
+        db = mysql_cluster.MysqlClusterDB(archive_url="file:///x")
+        t = {"nodes": [f"n{i}" for i in range(1, 6)]}
+        nem = mysql_cluster.ComponentKiller(db, "ndbd")
+        assert nem._hosts(t) == ["n1", "n2", "n3", "n4"]
+
+    def test_full_run_bank_under_ndbd_kills(self, tmp_path):
+        result = _run_suite(
+            tmp_path, mysql_cluster, mysql_cluster.mysql_cluster_test,
+            mysql_cluster.suite, "bank", "mysqld",
+            sim_cluster=_ndb_cluster, keep_nemesis=True,
+            nemesis="kill-ndbd", nemesis_interval=0.8)
+        assert result["results"]["valid"] is True, result["results"]
+        nem_ops = [o for o in result["history"]
+                   if o.process == "nemesis" and o.type == "info"
+                   and isinstance(o.value, list)
+                   and o.value and o.value[0] == "ndbd"]
+        assert any(o.value[1] == "killed" for o in nem_ops), nem_ops
+
+    def test_component_nemeses_registered(self):
+        t = mysql_cluster.mysql_cluster_test({
+            "workload": "bank", "nodes": ["a"],
+            "nemesis": "kill-ndbd", "time_limit": 5})
+        assert isinstance(t["nemesis"], mysql_cluster.ComponentKiller)
+        assert t["nemesis"].role == "ndbd"
