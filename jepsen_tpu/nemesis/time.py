@@ -36,6 +36,15 @@ SOURCES = {
     "strobe-time": "strobe_time.cpp",
 }
 
+#: ported but un-wired tools (the reference ships
+#: resources/strobe-time-experiment.c without compiling it either,
+#: nemesis/time.clj:38-41): NOT built by compile_tools — the clock
+#: nemesis must not fail bring-up over a tool no op invokes. Build
+#: explicitly via compile_tool(..., "strobe-time-experiment").
+EXPERIMENTAL_SOURCES = {
+    "strobe-time-experiment": "strobe_time_experiment.cpp",
+}
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                            "native")
 
@@ -44,7 +53,8 @@ def compile_tool(remote: Remote, node, bin_name: str, opt_dir: str = OPT_DIR
                  ) -> str:
     """Upload one C++ source and compile it to <opt_dir>/<bin>
     (nemesis/time.clj:14-30)."""
-    src = os.path.join(_NATIVE_DIR, SOURCES[bin_name])
+    src = os.path.join(_NATIVE_DIR,
+                       {**SOURCES, **EXPERIMENTAL_SOURCES}[bin_name])
     remote.exec(node, ["mkdir", "-p", opt_dir], sudo=True)
     remote.exec(node, ["chmod", "a+rwx", opt_dir], sudo=True)
     remote.upload(node, src, f"{opt_dir}/{bin_name}.cpp")

@@ -1,0 +1,179 @@
+"""SshRemote against a REAL OpenSSH sshd (VERDICT r2 item 9).
+
+tests/test_control_ssh.py pins the multiplexing contract against a
+bash stub; this file drives the same surface against an actual sshd on
+a localhost high port with a throwaway keypair, so escaping, sudo
+fallback, upload/download, and ControlMaster reuse are verified
+against real OpenSSH quirks. Skips gracefully when the OpenSSH
+binaries are not installed (this repo's CI image has none — the suite
+must stay green there)."""
+
+from __future__ import annotations
+
+import getpass
+import os
+import shutil
+import subprocess
+import time
+
+import pytest
+
+from jepsen_tpu.control import SshRemote
+from tests.helpers import free_port
+
+SSHD = shutil.which("sshd") or (
+    "/usr/sbin/sshd" if os.path.exists("/usr/sbin/sshd") else None)
+
+pytestmark = pytest.mark.skipif(
+    SSHD is None or not shutil.which("ssh")
+    or not shutil.which("ssh-keygen") or not shutil.which("scp"),
+    reason="OpenSSH (sshd/ssh/ssh-keygen/scp) not installed",
+)
+
+
+@pytest.fixture(scope="module")
+def sshd_server(tmp_path_factory):
+    """A throwaway sshd: host key + user key + sshd_config in a temp
+    dir, bound to 127.0.0.1 on a high port, authenticating the CURRENT
+    user by pubkey."""
+    td = tmp_path_factory.mktemp("sshd")
+    host_key = td / "host_key"
+    user_key = td / "user_key"
+    for key in (host_key, user_key):
+        subprocess.run(
+            ["ssh-keygen", "-q", "-t", "ed25519", "-N", "", "-f", str(key)],
+            check=True)
+    authorized = td / "authorized_keys"
+    authorized.write_bytes((user_key.with_suffix(".pub")).read_bytes())
+    authorized.chmod(0o600)
+    port = free_port()
+    config = td / "sshd_config"
+    config.write_text(
+        f"Port {port}\n"
+        "ListenAddress 127.0.0.1\n"
+        f"HostKey {host_key}\n"
+        f"AuthorizedKeysFile {authorized}\n"
+        "PasswordAuthentication no\n"
+        "KbdInteractiveAuthentication no\n"
+        "UsePAM no\n"
+        "StrictModes no\n"
+        f"PidFile {td}/sshd.pid\n"
+    )
+    # -D: foreground; -e: log to stderr (captured for debugging)
+    proc = subprocess.Popen(
+        [SSHD, "-D", "-e", "-f", str(config)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    # wait for the listener
+    import socket
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                break
+        except OSError:
+            if proc.poll() is not None:
+                pytest.skip(
+                    "sshd refused to start (container restrictions): "
+                    f"{proc.stderr.read().decode()[:300]}")
+            time.sleep(0.1)
+    else:
+        proc.kill()
+        pytest.skip("sshd never started listening")
+    yield {"port": port, "key": str(user_key), "user": getpass.getuser()}
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+@pytest.fixture
+def remote(sshd_server):
+    r = SshRemote(username=sshd_server["user"], port=sshd_server["port"],
+                  private_key_path=sshd_server["key"])
+    try:
+        r.connect("127.0.0.1")
+    except Exception as e:  # noqa: BLE001 — e.g. login shell vetoed
+        pytest.skip(f"cannot authenticate to local sshd: {e}")
+    yield r
+    r.disconnect("127.0.0.1")
+
+
+class TestRealSshd:
+    def test_exec_and_exit_codes(self, remote):
+        r = remote.exec("127.0.0.1", ["echo", "hello"])
+        assert r.out == "hello" and r.exit == 0
+        r = remote.exec("127.0.0.1", ["false"], check=False, retries=1)
+        assert r.exit == 1
+
+    def test_escaping_survives_real_shell(self, remote):
+        """The control layer's escaping against a REAL remote shell:
+        spaces, quotes, dollars, globs, semicolons."""
+        hairy = [
+            "plain",
+            "two words",
+            "it's",
+            'double"quote',
+            "$HOME",
+            "semi;colon",
+            "star*glob",
+            "back\\slash",
+        ]
+        for s in hairy:
+            r = remote.exec("127.0.0.1", ["printf", "%s", s])
+            assert r.out == s, s
+
+    def test_stdin_round_trip(self, remote):
+        r = remote.exec("127.0.0.1", ["cat"], stdin="line1\nline2")
+        assert r.out == "line1\nline2"
+
+    def test_sudo_wrapping_shape(self, remote, sshd_server):
+        """The sudo WRAPPER must produce a command real ssh+shell
+        accept: as root (or with passwordless sudo) it yields root;
+        otherwise the failure surfaces as a nonzero exit code — never
+        an exception or a mangled command."""
+        r = remote.exec("127.0.0.1", ["whoami"], sudo=True, check=False,
+                        retries=1)
+        if r.exit == 0:
+            assert r.out == "root"
+        else:
+            # no sudo / not permitted: a clean remote failure
+            assert r.exit != 0
+        # and the no-sudo path still reports the real login
+        r = remote.exec("127.0.0.1", ["whoami"])
+        assert r.out == sshd_server["user"]
+
+    def test_upload_download_round_trip(self, remote, tmp_path):
+        src = tmp_path / "up.txt"
+        src.write_text("payload ✓ with spaces\n")
+        dest = tmp_path / "remote_copy.txt"
+        remote.upload("127.0.0.1", str(src), str(dest))
+        back = tmp_path / "back.txt"
+        remote.download("127.0.0.1", str(dest), str(back))
+        assert back.read_text() == src.read_text()
+
+    def test_control_master_reused(self, remote, sshd_server):
+        """Multiplexing against real OpenSSH: after connect(), `ssh -O
+        check` reports a live master, and a burst of execs completes
+        fast (no per-command handshake)."""
+        d = remote._control_path_dir()
+        assert os.listdir(d), "no control socket created"
+        chk = subprocess.run(
+            ["ssh", *remote._opts(), "-O", "check",
+             f"{sshd_server['user']}@127.0.0.1"],
+            capture_output=True, text=True)
+        assert chk.returncode == 0, chk.stderr
+        t0 = time.monotonic()
+        for _ in range(10):
+            remote.exec("127.0.0.1", ["true"])
+        assert time.monotonic() - t0 < 5.0
+
+    def test_disconnect_closes_master(self, remote, sshd_server):
+        remote.exec("127.0.0.1", ["true"])
+        remote.disconnect("127.0.0.1")
+        chk = subprocess.run(
+            ["ssh", *remote._opts(), "-O", "check",
+             f"{sshd_server['user']}@127.0.0.1"],
+            capture_output=True, text=True)
+        # master gone (check fails) — a fresh exec still works by
+        # auto-establishing a new one
+        assert chk.returncode != 0
+        assert remote.exec("127.0.0.1", ["echo", "back"]).out == "back"
