@@ -18,6 +18,7 @@ import itertools
 import logging
 import random
 import socket
+import time
 
 from .. import checker as checker_mod
 from . import common as cmn
@@ -53,6 +54,13 @@ class AerospikeDB(ArchiveDB):
 
     def daemon_args(self, test, node) -> list:
         return ["--port", str(node_port(test, node))]
+
+    def log_files(self, test, node) -> list:
+        # netem.log is PauseNemesis(:net)'s chain output — the only
+        # post-mortem evidence when a backgrounded `tc qdisc add` fails
+        # (we can't surface errors live; see PauseNemesis._pause).
+        return super().log_files(test, node) + [
+            f"{_suite.dir(test, node)}/netem.log"]
 
     def probe_ready(self, test, node) -> bool:
         conn = ap.AerospikeConn(node_host(test, node),
@@ -265,6 +273,9 @@ class PauseNemesis(nemesis.Nemesis):
         self.mode = mode
         self.masters_limit = masters_limit
         self.pause_delay = pause_delay
+        # how long to wait after backgrounding the netem chain for the
+        # delay to take effect (tests zero this)
+        self.settle_s = 1.0
         self.paused: set = set()
 
     def _pidfile(self, test, node) -> str:
@@ -282,17 +293,25 @@ class PauseNemesis(nemesis.Nemesis):
             return "paused"
         delay_ms = int(self.pause_delay * 1000)
         hold_s = int(self.pause_delay) + 1
-        # the (...)& subshell is the self-restoring mini-daemon: the
-        # delay severs our own control connection, so the restore MUST
-        # be backgrounded before this command returns (a bare
-        # `a; b; c &` would background only `c` and block in `b`)
+        # The ENTIRE add/sleep/del chain is the backgrounded
+        # self-restoring mini-daemon: the netem delay severs our own
+        # control connection the instant `tc qdisc add` lands, so even
+        # the add must be in the subshell — a foreground add would trap
+        # this exec's own reply behind the delay it just installed
+        # (pause.clj:46-56 backgrounds the whole chain the same way).
+        # Consequence (inherent to the reference's "terrible hack"): an
+        # add failure can't be surfaced — once the delay lands we can't
+        # talk to the node until it self-heals, so there is no useful
+        # moment to verify. Chain output goes to netem.log in the suite
+        # dir (snarfed with the other logs) for post-mortem instead.
+        chain_log = f"{_suite.dir(test, node)}/netem.log"
         remote.exec(
             node,
             ["nohup", "bash", "-c",
-             f"tc qdisc add dev eth0 root netem delay {delay_ms}ms 1ms "
+             f"(tc qdisc add dev eth0 root netem delay {delay_ms}ms 1ms "
              f"distribution normal; "
-             f"(sleep {hold_s}; tc qdisc del dev eth0 root) "
-             "</dev/null >/dev/null 2>&1 &"],
+             f"sleep {hold_s}; tc qdisc del dev eth0 root) "
+             f"</dev/null >>{chain_log} 2>&1 &"],
             sudo=_suite.sudo(test))
         return "net-delayed"
 
@@ -323,6 +342,12 @@ class PauseNemesis(nemesis.Nemesis):
             for node in targets:
                 out[node] = self._pause(test, node)
                 self.paused.add(node)
+            # One settle for the whole batch (not per node — that would
+            # stagger the "concurrent" pause window by settle_s per
+            # node): give the backgrounded netem adds a beat to land
+            # before reporting the nodes paused.
+            if self.mode == "net" and out and self.settle_s:
+                time.sleep(self.settle_s)
             return op.with_(type="info", value=out or "at-limit")
         if op.f in ("resume", "stop"):
             out = {}

@@ -118,8 +118,12 @@ int main(int argc, char **argv) {
   bool weird = false;
   int64_t count = 0;
 
-  while (mono_now() < end) {
+  // Bound on the TICK, not the current time: checking `mono_now() <
+  // end` before sleeping would let the final adjustment land up to one
+  // full period past the requested duration.
+  for (;;) {
     const Nanos tick = next_tick(period, anchor, mono_now());
+    if (tick >= end) break;
     std::this_thread::sleep_for(tick - mono_now());
     set_wall_clock(mono_now() + (weird ? normal_offset : weird_offset), tz,
                    dry_run);

@@ -98,6 +98,13 @@ def encode_entries(es: Entries, jm, n_pad: int) -> dict:
         rp = np.asarray(es.ret_pos, np.int32) + 1
         call_node[:n] = cp
         ret_node[:n] = rp
+        # cp/rp must be globally unique node positions: numpy fancy-index
+        # writes have undefined order on duplicates, so a collision would
+        # silently corrupt node_entry (history.Entries guarantees distinct
+        # call/ret positions; this guards the invariant).
+        both = np.concatenate([cp, rp])
+        assert len(np.unique(both)) == len(both), \
+            "duplicate call/ret node positions in Entries"
         idx = np.arange(n, dtype=np.int32)
         node_entry[cp] = idx
         node_entry[rp] = idx

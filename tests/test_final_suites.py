@@ -741,6 +741,7 @@ class TestAerospikePause:
         db = aerospike.AerospikeDB(archive_url="file:///x")
         nem = aerospike.PauseNemesis(db, "net", masters_limit=2,
                                      pause_delay=30.0)
+        nem.settle_s = 0  # hermetic: no real netem to wait for
         test = {"remote": FakeRemote(), "nodes": ["n1", "n2"],
                 "aerospike": {"sudo": None}}
         out = nem.invoke(test, Op("nemesis", "invoke", "pause",
@@ -749,10 +750,14 @@ class TestAerospikePause:
         for _node, argv in calls:
             script = argv[-1]
             assert "tc qdisc add dev eth0 root netem delay 30000ms" in script
-            # the restore must run in a BACKGROUNDED subshell — a bare
-            # `a; b; c &` would background only `c` and block in the
-            # sleep over a connection we just delayed by 30s
-            assert "(sleep 31; tc qdisc del dev eth0 root)" in script
+            # the WHOLE add/sleep/del chain must run in a BACKGROUNDED
+            # subshell: a foreground `tc qdisc add` would trap this
+            # exec's own reply behind the 30s delay it just installed,
+            # blocking the nemesis thread for the pause window
+            start = script.index("(")
+            chain = script[start:]
+            assert "tc qdisc add" in chain.split(")")[0]
+            assert "sleep 31; tc qdisc del dev eth0 root)" in chain
             assert argv[0] == "nohup" and script.rstrip().endswith("&")
         n_pause_calls = len(calls)
         out = nem.invoke(test, Op("nemesis", "invoke", "resume", None))
@@ -783,6 +788,7 @@ class TestAerospikePause:
 
         db = aerospike.AerospikeDB(archive_url="file:///x")
         nem = aerospike.PauseNemesis(db, "net", masters_limit=1)
+        nem.settle_s = 0  # hermetic: no real netem to wait for
         test = {"remote": FakeRemote(), "nodes": ["n1", "n2", "n3"],
                 "aerospike": {"sudo": None}}
         out = nem.invoke(test, Op("nemesis", "invoke", "pause",
