@@ -70,14 +70,18 @@ TRUNCATE = 10
 # the native engine triages each lane with a small step budget first
 # (~8-10M steps/s, no launch latency — a typical valid per-key lane
 # resolves in well under a millisecond) and then finishes the
-# unresolved tail with the full budget. The pallas lane kernel runs
-# steps at roughly native's rate kernel-resident, but its bounded
-# VMEM cache prunes worse than native's unbounded memo and host
-# packing/transfer add several hundred ms, so with a working C++
-# toolchain native wins end-to-end at every measured shape — auto
-# escalates to pallas only when native is UNAVAILABLE (e.g. a TPU VM
-# without a compiler), where it beats the pure-Python host search by
-# >10x on batches.
+# unresolved tail with the full budget. The pallas lane kernel beats
+# native kernel-resident, but on this tunnel-attached host the fixed
+# dispatch+fetch round trip (~110ms) plus ~25-50MB/s transfer set an
+# end-to-end floor native undercuts at shallow shapes, and on DEEP
+# refutation searches the kernel's bounded VMEM cache re-explores
+# ~20x the steps native's unbounded memo prunes — so with a working
+# C++ toolchain native wins end-to-end at every measured shape (r4:
+# the gap closed from ~2.4x to ~1.1-1.3x after single-buffer
+# transfers, memoized encoding, and in-kernel counterexamples, but
+# did not invert). Auto escalates to pallas only when native is
+# UNAVAILABLE (e.g. a TPU VM without a compiler), where it beats the
+# pure-Python host search by >10x on batches.
 TRIAGE_MAX_STEPS = 2_000
 
 
@@ -91,8 +95,8 @@ def _pallas_eligible(model, entries_list) -> bool:
     jm = mjit.for_model(model)
     if jm is None or not entries_list:
         return False
-    n_pad = max(wgl_pallas_vec._next_pow2(
-        max(len(es) for es in entries_list)), 32)
+    n_pad = wgl_pallas_vec._pad_size(
+        max(len(es) for es in entries_list))
     if not wgl_pallas_vec.eligible(jm, n_pad):
         return False
     return all(jm.lane_eligible(es) for es in entries_list)

@@ -4,4 +4,41 @@ wgl_host — Wing-Gong-Lowe linearizability search on host (semantics
           oracle + fallback for models without int32 encodings).
 wgl_tpu  — the same search as a jitted bitmask-DFS over int32 tensors,
           vmapped over independent keys and sharded over a device mesh.
-"""
+
+Importing this package configures JAX's persistent compilation cache
+(before any kernel compiles): search-kernel variants cost seconds to
+tens of seconds of XLA/Mosaic compile each, and a fresh process pays
+all of them again without a disk cache. Override the location with
+JEPSEN_TPU_COMPILE_CACHE (set to "off" to disable)."""
+
+import os as _os
+
+
+def _configure_compilation_cache() -> None:
+    ours = _os.environ.get("JEPSEN_TPU_COMPILE_CACHE")
+    # precedence: our env var > the standard JAX env var (this jax
+    # version does not read it itself, so apply the user's value for
+    # them) > a dir the application configured before import > default
+    path = ours or _os.environ.get("JAX_COMPILATION_CACHE_DIR") \
+        or _os.path.join(
+            _os.path.expanduser("~"), ".cache", "jepsen-tpu", "xla-cache")
+    if path.lower() in ("", "0", "off", "none"):
+        return
+    try:
+        import jax
+
+        if (ours is None
+                and _os.environ.get("JAX_COMPILATION_CACHE_DIR") is None
+                and jax.config.jax_compilation_cache_dir):
+            return  # application already configured a cache dir
+        jax.config.update("jax_compilation_cache_dir", path)
+        # search kernels recompile per shape bucket; even small entries
+        # are worth keeping, and ~0.5s is well under a kernel compile
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:  # noqa: BLE001 — older jax or read-only home
+        pass
+
+
+_configure_compilation_cache()

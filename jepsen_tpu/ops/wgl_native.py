@@ -119,13 +119,7 @@ def analysis(
     if es.n_completed == 0:
         return WGLResult(valid=True, final_state=model)
 
-    codec = jm.lane_codec(es)
-    f = np.empty(n, np.int32)
-    v1 = np.empty(n, np.int32)
-    v2 = np.empty(n, np.int32)
-    for e in range(n):
-        f[e], v1[e], v2[e] = jm.encode_entry(es.f[e], es.value_out[e],
-                                             codec)
+    f, v1, v2 = jm.encode_lane(es)
     crashed = np.ascontiguousarray(es.crashed, np.uint8)
     call_pos = np.ascontiguousarray(es.call_pos, np.int64)
     ret_pos = np.ascontiguousarray(es.ret_pos, np.int64)

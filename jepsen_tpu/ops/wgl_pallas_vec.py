@@ -19,18 +19,34 @@ vector unit:
   is no dynamic indexing at all, which sidesteps Mosaic's
   no-dynamic-lane-indexing and scalar-store constraints entirely and
   keeps every op on the VPU;
-- the memo cache is exact full-key compare against ALL slots
-  (direct-mapped insert by hash). Pruning differs from the host's
-  unbounded 8-probe memo — step counts may differ — but any
-  exact-compare cache is sound, so VERDICTS are bit-identical to the
-  host search (asserted by the parity tests).
+- the memo cache is exact full-key compare against ALL slots (insert
+  slot from a carried Zobrist fold, computed inline — no table).
+  Pruning differs from the host's unbounded memo — step counts may
+  differ, and DEEP refutation searches re-explore what native's
+  unbounded memo prunes (measured ~20x the steps on exhaustive
+  deep batches; bounded VMEM cannot replicate an unbounded memo) —
+  but any exact-compare cache is sound, so VERDICTS are bit-identical
+  to the host search (asserted by the parity tests).
+- INVALID lanes carry their counterexample out of the kernel (deepest
+  prefix + stuck entry, wgl_search.cpp:329-341 semantics): the host
+  formats it instead of re-searching.
+- everything crosses the tunnel as ONE bit-packed int32 buffer each
+  way: per-array fixed transfer cost (~45ms) and dispatch+fetch round
+  trip (~110ms) dominate this host's end-to-end walls, so array count
+  is the first-order term.
 
 Blocks of 128 lanes run as sequential grid programs; within a block,
 lanes that finish idle (gated) until the block's while loop drains.
+A capped first pass resolves easy lanes cheaply and survivors repack
+densely (two-pass scheduling) so one deep lane can't hold 32 blocks
+at the full budget.
 
 Scope: scalar kernel models (cas-register / register / mutex — one
-int32 state, state_in_key) and histories up to MAX_PAD entries.
-Everything else routes to ops/wgl_tpu.py.
+int32 state, state_in_key) AND the unordered queue (count-vector
+state laid out as extra sublane rows per lane column; memo key is the
+bitset alone, backtracking is the exact inverse step), for histories
+up to MAX_PAD entries. The fifo queue and larger pads route to
+ops/wgl_tpu.py.
 
 On non-TPU backends the kernel runs in pallas interpret mode (the CPU
 test suite uses this for parity); on TPU it compiles via Mosaic.
@@ -47,16 +63,16 @@ import jax.numpy as jnp
 
 from ..history import Entries, entries as make_entries
 from ..models import jit as mjit
-from .wgl_host import WGLResult, recover_invalid
+from .wgl_host import WGLResult
 from .wgl_tpu import (RUNNING, VALID, INVALID, UNKNOWN,
-                      DEFAULT_MAX_STEPS, _next_pow2,
-                      _zobrist_table, encode_entries)
+                      DEFAULT_MAX_STEPS, _next_pow2, _pad_size)
 
 log = logging.getLogger("jepsen_tpu.ops.wgl_pallas_vec")
 
 LANES = 128                  # lanes per grid program (one vreg row)
-CACHE_SLOTS = 128            # direct-mapped exact-key cache rows
+CACHE_SLOTS = 128            # exact-key cache rows (compared in full)
 MAX_PAD = 1024               # bitset words stay a small sublane block
+PASS1_CAP = 512              # first-pass step budget (two-pass sched)
 
 
 def _m_pad(n_pad: int) -> int:
@@ -73,14 +89,31 @@ def _nw_pad(n_pad: int) -> int:
 
 
 def eligible(jm, n_pad: int) -> bool:
-    """Scalar one-word models only; the queue models carry vector
-    state that doesn't fit the one-lane-per-column layout."""
-    return (isinstance(jm, mjit.JitModel)
-            and jm.state_in_key
-            and n_pad <= MAX_PAD)
+    """Scalar one-word models, plus the unordered queue (vector count
+    state as extra sublane rows per lane column; its memo key is the
+    bitset alone and backtracking is an exact inverse step, so neither
+    a state snapshot stack nor state words in the cache are needed).
+    The fifo queue stays on the XLA path: its memo key needs the
+    canonicalized ring buffer, and a per-lane dynamic roll has no
+    cheap lane-vectorized form."""
+    if n_pad > MAX_PAD:
+        return False
+    if isinstance(jm, mjit.JitModel) and jm.state_in_key:
+        return True
+    return getattr(jm, "name", "") == "unordered-queue"
 
 
-def _make_kernel(jm, n_pad: int, max_steps: int):
+def _state_pad(jm, entries_list) -> int:
+    """Padded state rows for a batch: 1 for scalar models, the max
+    lane width padded to a power of two (>=8, the sublane tile) for
+    the unordered queue — bucketed so re-batches reuse kernels."""
+    if isinstance(jm, mjit.JitModel):
+        return 1
+    w = max((jm.lane_width(es) for es in entries_list), default=1)
+    return max(8, _next_pow2(w))
+
+
+def _make_kernel(jm, n_pad: int, n_state: int):
     from jax.experimental import pallas as pl  # noqa: F401
 
     m_pad = _m_pad(n_pad)
@@ -88,14 +121,18 @@ def _make_kernel(jm, n_pad: int, max_steps: int):
     nw_pad = _nw_pad(n_pad)
     # plain Python ints — jnp values created outside the kernel would
     # be captured tracers, which pallas rejects
-    init_state_c = int(jm.init_state)
-    fnv_basis_c = int(np.uint32(2166136261).astype(np.int32))
+    uq = not isinstance(jm, mjit.JitModel)   # unordered queue family
+    init_state_c = 0 if uq else int(jm.init_state)
+    # queue memo keys are the bitset alone (state is a function of
+    # WHICH ops linearized); scalar keys append the one state word
+    key_words = nw if uq else nw + 1
     cache_mask_c = CACHE_SLOTS - 1
 
     def kernel(f_ref, v1_ref, v2_ref, crashed_ref, call_ref, ret_ref,
                entry_ref, is_call_ref, nxt0_ref, prv0_ref, ncomp_ref,
-               ztab_ref,
+               msteps_ref,
                verdict_ref, steps_ref, depth_ref,
+               bestd_ref, stuck_ref, beststack_ref,
                nxt, prv, stack_e, stack_s, cache, cache_used):
         i32 = jnp.int32
         m_iota = jax.lax.broadcasted_iota(i32, (m_pad, LANES), 0)
@@ -107,88 +144,144 @@ def _make_kernel(jm, n_pad: int, max_steps: int):
         # stale cache entry from another block would wrongly match) ---
         nxt[...] = nxt0_ref[...]
         prv[...] = prv0_ref[...]
-        cache[...] = jnp.zeros((CACHE_SLOTS, (nw + 1) * LANES), i32)
+        cache[...] = jnp.zeros((CACHE_SLOTS, key_words * LANES), i32)
         cache_used[...] = jnp.zeros((CACHE_SLOTS, LANES), i32)
+        beststack_ref[...] = jnp.zeros((n_pad, LANES), i32)
 
         n_completed = ncomp_ref[...]                     # [1, L]
+        # step budget is a runtime INPUT, not a compile-time constant:
+        # one compiled kernel serves every cap (the two-pass scheduler
+        # below re-runs survivors with a bigger budget)
+        max_steps = msteps_ref[...]                      # [1, L]
 
-        def rd(ref, rows, idx):
-            """ref[idx] per lane as a one-hot masked reduction.
-            Out-of-range idx (e.g. depth-1 at depth 0) yields zeros;
-            every consumer of such a read is gated."""
+        def onehot(rows, idx):
+            """The [rows, L] one-hot mask for a per-lane index. Built
+            ONCE per distinct index and shared by every read of that
+            index — mask construction was ~half the read cost."""
             iota = {m_pad: m_iota, n_pad: n_iota}[rows]
-            mask = iota == idx                           # [rows, L]
+            return iota == idx                           # [rows, L]
+
+        def pick(mask, ref):
+            """ref[idx] per lane as a masked reduction over a shared
+            one-hot mask. Out-of-range idx (e.g. depth-1 at depth 0)
+            yields zeros; every consumer of such a read is gated."""
             return jnp.sum(jnp.where(mask, ref[...], 0),
                            axis=0, keepdims=True)        # [1, L]
 
-        def mix_hash(h_lin, state):
-            h = ((h_lin ^ state) * i32(16777619)).astype(jnp.uint32)
-            h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
-            return (h ^ (h >> 13)).astype(i32)
+        def zmix(x):
+            """splitmix-style diffusion of an entry id -> its Zobrist
+            constant, computed inline on [1, L] rows — same retention
+            quality as the old per-entry random table without the
+            (n_pad, L) table reads per step."""
+            x = (x + i32(-1640531527)) * i32(-1640531535)
+            x = (x ^ (x >> 15)) * i32(-2048144789)
+            return x ^ (x >> 13)
+
+        if uq:
+            s_iota = jax.lax.broadcasted_iota(i32, (n_state, LANES), 0)
 
         init = (
             nxt0_ref[0:1, :],                            # node
-            jnp.full((1, LANES), init_state_c, i32),     # state
+            # scalar models: one state word; unordered queue: count
+            # vector over the lane's value slots, one sublane row each
+            (jnp.zeros((n_state, LANES), i32) if uq
+             else jnp.full((1, LANES), init_state_c, i32)),
             jnp.zeros((nw_pad, LANES), i32),             # lin bitset
-            jnp.full((1, LANES), fnv_basis_c, i32),      # h_lin
+            jnp.zeros((1, LANES), i32),                  # h: zobrist fold
             jnp.zeros((1, LANES), i32),                  # depth
             jnp.zeros((1, LANES), i32),                  # completed
             jnp.zeros((1, LANES), i32),                  # steps
             jnp.where(n_completed == 0, i32(VALID), i32(RUNNING)),
+            jnp.full((1, LANES), -1, i32),               # best depth
+            jnp.full((1, LANES), -1, i32),               # stuck entry
         )
 
         def cond(st):
             return jnp.any((st[7] == RUNNING) & (st[6] < max_steps))
 
         def body(st):
-            node, state, lin, h_lin, depth, completed, steps, verdict = st
+            (node, state, lin, h_lin, depth, completed, steps, verdict,
+             bestd, stuck) = st
             active = (verdict == RUNNING) & (steps < max_steps)
             zero = jnp.zeros((1, LANES), i32)
 
-            e = rd(entry_ref, m_pad, node)
-            is_call = (node != 0) & (rd(is_call_ref, m_pad, node) != 0)
+            mask_node = onehot(m_pad, node)
+            e = pick(mask_node, entry_ref)
+            is_call = (node != 0) & (pick(mask_node, is_call_ref) != 0)
 
-            e2 = rd(stack_e, n_pad, depth - 1)
+            mask_d = onehot(n_pad, depth - 1)
+            e2 = pick(mask_d, stack_e)
 
-            f_e = rd(f_ref, n_pad, e)
-            v1_e = rd(v1_ref, n_pad, e)
-            v2_e = rd(v2_ref, n_pad, e)
-            crashed_e = rd(crashed_ref, n_pad, e)
-            cn = rd(call_ref, n_pad, e)
-            rn = rd(ret_ref, n_pad, e)
-            z_e = rd(ztab_ref, n_pad, e)
-            f_e2 = rd(f_ref, n_pad, e2)
-            v1_e2 = rd(v1_ref, n_pad, e2)    # noqa: F841 (symmetry)
-            crashed_e2 = rd(crashed_ref, n_pad, e2)
-            cn2 = rd(call_ref, n_pad, e2)
-            rn2 = rd(ret_ref, n_pad, e2)
-            z_e2 = rd(ztab_ref, n_pad, e2)
-            del f_e2, v1_e2
+            mask_e = onehot(n_pad, e)
+            f_e = pick(mask_e, f_ref)
+            v1_e = pick(mask_e, v1_ref)
+            v2_e = pick(mask_e, v2_ref)
+            crashed_e = pick(mask_e, crashed_ref)
+            cn = pick(mask_e, call_ref)
+            rn = pick(mask_e, ret_ref)
+            mask_e2 = onehot(n_pad, e2)
+            crashed_e2 = pick(mask_e2, crashed_ref)
+            cn2 = pick(mask_e2, call_ref)
+            rn2 = pick(mask_e2, ret_ref)
 
-            new_state, ok = jm.step(state, f_e, v1_e, v2_e)
-            new_state = new_state.astype(i32)
+            if uq:
+                # unordered queue inline (QueueJitModel.vec_step
+                # semantics without dynamic indexing): v1 is the
+                # lane's value slot; enqueue always ok, dequeue ok iff
+                # the slot count is positive. NIL32/-1 f-codes make
+                # mask_slot all-false and ok False.
+                is_enq = f_e == 0
+                is_deq = f_e == 1
+                mask_slot = s_iota == v1_e               # [S, L]
+                cnt = jnp.sum(jnp.where(mask_slot, state, 0),
+                              axis=0, keepdims=True)
+                ok = is_enq | (is_deq & (cnt > 0))
+                new_state = state + jnp.where(
+                    mask_slot, jnp.where(is_enq, 1, -1), 0)
+            else:
+                new_state, ok = jm.step(state, f_e, v1_e, v2_e)
+                new_state = new_state.astype(i32)
             can_lin = active & is_call & ok
 
             word = e // 32
             bit = i32(1) << (e % 32)
             new_lin = lin | jnp.where(w_iota == word, bit, i32(0))
-            new_h = h_lin ^ z_e
 
-            # ---- cache: exact full-key compare against ALL slots ----
-            hmix = mix_hash(new_h, new_state)
-            slot = hmix & i32(cache_mask_c)              # [1, L]
+            # ---- cache: exact full-key compare against ALL slots.
+            # The insert slot comes from the carried Zobrist fold (each
+            # lift/pop XORs the entry's zmix constant): the lookup
+            # never consults the insert position, so the slot choice is
+            # purely a retention policy — but retention quality needs
+            # real diffusion (measured: FIFO cursors and direct
+            # key-folds both leave ~40-60% more step-capped unknowns
+            # than the Zobrist fold at equal slots) ----
+            new_h = h_lin ^ zmix(e)
+            hm = (new_h if uq else new_h ^ new_state) * i32(16777619)
+            hm = hm ^ (hm >> 15)
+            slot = hm & i32(cache_mask_c)                # [1, L]
             eq = cache_used[...] != 0                    # [C, L]
             for w in range(nw):
                 eq = eq & (cache[:, w * LANES:(w + 1) * LANES]
                            == new_lin[w:w + 1, :])
-            eq = eq & (cache[:, nw * LANES:(nw + 1) * LANES] == new_state)
+            if not uq:  # queue keys are the bitset alone
+                eq = eq & (cache[:, nw * LANES:(nw + 1) * LANES]
+                           == new_state)
             found = jnp.max(eq.astype(i32), axis=0, keepdims=True) != 0
 
             do_lift = can_lin & ~found
             lift_completed = completed + jnp.where(crashed_e != 0, 0, 1)
 
             can_pop = depth > 0
-            pop_state = rd(stack_s, n_pad, depth - 1)
+            if uq:
+                # exact inverse step (has_unstep): un-apply e2 instead
+                # of restoring a snapshot — no stack_s at all
+                v1_e2 = pick(mask_e2, v1_ref)
+                f_e2 = pick(mask_e2, f_ref)
+                mask_slot2 = s_iota == v1_e2
+                pop_state = state + jnp.where(
+                    mask_slot2, jnp.where(f_e2 == 0, -1, 1), 0)
+            else:
+                pop_state = pick(mask_d, stack_s)
             word2 = e2 // 32
             bit2 = i32(1) << (e2 % 32)
             pop_lin = lin & ~jnp.where(w_iota == word2, bit2, i32(0))
@@ -198,20 +291,37 @@ def _make_kernel(jm, n_pad: int, max_steps: int):
             backtrack = active & ~is_call
             do_back = backtrack & can_pop
 
+            # ---- counterexample tracking (native wgl_search.cpp
+            # :329-333 semantics): at every return event, if the
+            # current prefix is the deepest seen, snapshot it and the
+            # entry we're stuck at — so INVALID lanes carry their
+            # counterexample out of the kernel and the host never
+            # re-searches them ----
+            upd = backtrack & (depth > bestd)
+            bestd_out = jnp.where(upd, depth, bestd)
+            stuck_out = jnp.where(
+                upd, jnp.where(node == 0, i32(-1), e), stuck)
+            beststack_ref[...] = jnp.where(
+                upd, stack_e[...], beststack_ref[...])
+
             # ---- linked list: raw reads, then the same scalar-fixup
             # algebra as the XLA dense form (round A never
             # materializes) ----
-            nxt_cn = rd(nxt, m_pad, cn)
-            prv_cn = rd(prv, m_pad, cn)
-            nxt_rn = rd(nxt, m_pad, rn)
-            prv_rn = rd(prv, m_pad, rn)
-            nxt_rn2 = rd(nxt, m_pad, rn2)
-            prv_rn2 = rd(prv, m_pad, rn2)
-            nxt_cn2 = rd(nxt, m_pad, cn2)
-            prv_cn2 = rd(prv, m_pad, cn2)
+            mask_cn = onehot(m_pad, cn)
+            mask_rn = onehot(m_pad, rn)
+            mask_rn2 = onehot(m_pad, rn2)
+            mask_cn2 = onehot(m_pad, cn2)
+            nxt_cn = pick(mask_cn, nxt)
+            prv_cn = pick(mask_cn, prv)
+            nxt_rn = pick(mask_rn, nxt)
+            prv_rn = pick(mask_rn, prv)
+            nxt_rn2 = pick(mask_rn2, nxt)
+            prv_rn2 = pick(mask_rn2, prv)
+            nxt_cn2 = pick(mask_cn2, nxt)
+            prv_cn2 = pick(mask_cn2, prv)
             nxt_0 = nxt[0:1, :]
             prv_0 = prv[0:1, :]
-            nxt_node = rd(nxt, m_pad, node)
+            nxt_node = pick(mask_node, nxt)
 
             posA_n = jnp.where(do_lift, prv_cn,
                                jnp.where(do_back, prv_rn2, zero))
@@ -244,19 +354,22 @@ def _make_kernel(jm, n_pad: int, max_steps: int):
                 m_iota == posB_p, valB_p,
                 jnp.where(m_iota == posA_p, valA_p, prv[...]))
 
-            # ---- cache insert (direct-mapped) + stack push ----
+            # ---- cache insert (zobrist-hashed slot) + stack push ----
             sl = (c_iota == slot) & do_lift              # [C, L]
             for w in range(nw):
                 cache[:, w * LANES:(w + 1) * LANES] = jnp.where(
                     sl, new_lin[w:w + 1, :],
                     cache[:, w * LANES:(w + 1) * LANES])
-            cache[:, nw * LANES:(nw + 1) * LANES] = jnp.where(
-                sl, new_state, cache[:, nw * LANES:(nw + 1) * LANES])
+            if not uq:
+                cache[:, nw * LANES:(nw + 1) * LANES] = jnp.where(
+                    sl, new_state,
+                    cache[:, nw * LANES:(nw + 1) * LANES])
             cache_used[...] = jnp.where(sl, i32(1), cache_used[...])
 
             push = (n_iota == depth) & do_lift
             stack_e[...] = jnp.where(push, e, stack_e[...])
-            stack_s[...] = jnp.where(push, state, stack_s[...])
+            if not uq:  # the queue backtracks by inverse step instead
+                stack_s[...] = jnp.where(push, state, stack_s[...])
 
             # ---- next scalars ----
             node_out = jnp.where(
@@ -270,7 +383,7 @@ def _make_kernel(jm, n_pad: int, max_steps: int):
                 do_lift, new_lin, jnp.where(do_back, pop_lin, lin))
             h_out = jnp.where(
                 do_lift, new_h,
-                jnp.where(do_back, h_lin ^ z_e2, h_lin))
+                jnp.where(do_back, h_lin ^ zmix(e2), h_lin))
             depth_out = jnp.where(
                 do_lift, depth + 1, jnp.where(do_back, depth - 1, depth))
             completed_out = jnp.where(
@@ -281,71 +394,136 @@ def _make_kernel(jm, n_pad: int, max_steps: int):
                 jnp.where(backtrack & ~can_pop, i32(INVALID), verdict))
 
             return (node_out, state_out, lin_out, h_out, depth_out,
-                    completed_out, steps + active.astype(i32), verdict_out)
+                    completed_out, steps + active.astype(i32), verdict_out,
+                    bestd_out, stuck_out)
 
         out = jax.lax.while_loop(cond, body, init)
         final = jnp.where(out[7] == RUNNING, jnp.int32(UNKNOWN), out[7])
         verdict_ref[...] = final
         steps_ref[...] = out[6]
         depth_ref[...] = out[4]
+        bestd_ref[...] = out[8]
+        stuck_ref[...] = out[9]
 
     return kernel, m_pad
 
 
 def _pack(entries_list, jm, n_pad: int) -> tuple[dict, int]:
-    """Pack lanes column-wise into [rows, n_blocks*LANES] arrays.
+    """Pack lanes column-wise into the NARROWEST per-entry arrays.
+    Only genuine per-entry facts cross the host->device boundary (f/
+    crashed as int8, call/ret positions as int16, values as int32);
+    the node maps, initial linked list, and Zobrist table are derived
+    on device in _launcher's jitted prologue. This cuts host pack time
+    and tunnel transfer ~4x — the costs that made native win
+    end-to-end at every shape in BENCH_r03.
+
     Padding lanes have n_completed == 0, so they go VALID at init and
-    idle through the block's loop."""
-    ents = [encode_entries(es, jm, n_pad) for es in entries_list]
+    idle through the block's loop. Padded ENTRIES aim their call/ret
+    positions at the trash row m_pad-1 (> 2*n_pad+1 is never true, but
+    the row is outside every reachable node id, so their device-side
+    scatters land where no read ever looks)."""
     m_pad = _m_pad(n_pad)
-    n_lanes = len(ents)
+    n_lanes = len(entries_list)
+    # block counts bucket to powers of two so re-batches (the two-pass
+    # scheduler's survivor pass) reuse compiled kernels instead of
+    # paying a fresh pallas trace per exact width
     n_blocks = (n_lanes + LANES - 1) // LANES
+    n_blocks = 1 if n_blocks <= 1 else _next_pow2(n_blocks)
     width = n_blocks * LANES
+    # ONE bit-packed buffer for the whole batch: every host->device
+    # transfer pays the tunnel's fixed per-array cost (~45ms) plus
+    # ~50MB/s of bandwidth, so both array COUNT and BYTES matter
+    # (measured: ten arrays 569ms, one wide int32 buffer 267ms, this
+    # layout ~150ms at 4096 lanes). Row blocks, all int32:
+    #   [0:n)     meta: (f+1) | crashed<<3 | cp<<4 | rp<<16
+    #   [n:2n)    v1        [2n:3n)  v2
+    #   [3n:3n+m) node_entry | node_is_call<<12
+    #   [-1]      n | n_completed<<16
+    # cp/rp fit 12 bits (m_pad <= 2*1024+8), f+1 fits 3, node_entry
+    # fits 12 (n_pad <= 1024); padded entries aim their (unused) node
+    # positions at the trash row m_pad-1.
+    rows = 3 * n_pad + m_pad + 1
+    buf = np.zeros((rows, width), np.int32)
+    v1 = buf[n_pad:2 * n_pad]
+    v2 = buf[2 * n_pad:3 * n_pad]
+    v1.fill(mjit.NIL32)
+    v2.fill(mjit.NIL32)
 
-    def col(key, rows):
-        out = np.zeros((rows, width), np.int32)
-        for i, e in enumerate(ents):
-            a = np.asarray(e[key]).astype(np.int32)
-            out[:a.shape[0], i] = a
-        return out
+    ns = np.array([len(es) for es in entries_list], np.int64)
+    total = int(ns.sum())
+    f_flat = np.empty(total, np.int32)
+    v1_flat = np.empty(total, np.int32)
+    v2_flat = np.empty(total, np.int32)
+    pos = 0
+    for es in entries_list:
+        n = len(es)
+        if n:
+            (f_flat[pos:pos + n], v1_flat[pos:pos + n],
+             v2_flat[pos:pos + n]) = jm.encode_lane(es)
+            pos += n
+    nonempty = [es for es in entries_list if len(es)]
+    cr_flat = (np.concatenate([es.crashed for es in nonempty])
+               if nonempty else np.zeros(0, bool))
+    # +1: node ids are positions shifted past the head sentinel 0
+    # (history.entries guarantees call/ret positions are a permutation
+    # of 0..2n-1; wgl_tpu.encode_entries asserts it)
+    cp_flat = (np.concatenate([np.asarray(es.call_pos) for es in nonempty])
+               if nonempty else np.zeros(0, np.int64)).astype(np.int32) + 1
+    rp_flat = (np.concatenate([np.asarray(es.ret_pos) for es in nonempty])
+               if nonempty else np.zeros(0, np.int64)).astype(np.int32) + 1
 
-    packed = {
-        "f": col("f", n_pad),
-        "v1": col("v1", n_pad),
-        "v2": col("v2", n_pad),
-        "crashed": col("crashed", n_pad),
-        "call_node": col("call_node", n_pad),
-        "ret_node": col("ret_node", n_pad),
-        "node_entry": col("node_entry", m_pad),
-        "node_is_call": col("node_is_call", m_pad),
-        "nxt0": col("nxt0", m_pad),
-        "prv0": col("prv0", m_pad),
-        "n_completed": np.zeros((1, width), np.int32),
-        "ztab": np.broadcast_to(
-            _zobrist_table(n_pad).astype(np.int32)[:, None],
-            (n_pad, width)).copy(),
-    }
-    for i, e in enumerate(ents):
-        packed["n_completed"][0, i] = e["n_completed"]
-    return packed, n_blocks
+    lane_idx = np.repeat(np.arange(n_lanes), ns)
+    row_idx = np.arange(total) - np.repeat(np.cumsum(ns) - ns, ns)
+
+    cp2d = np.full((n_pad, width), m_pad - 1, np.int32)
+    rp2d = np.full((n_pad, width), m_pad - 1, np.int32)
+    f2d = np.full((n_pad, width), -1, np.int32)  # padded: never lin
+    cr2d = np.zeros((n_pad, width), np.int32)
+    cp2d[row_idx, lane_idx] = cp_flat
+    rp2d[row_idx, lane_idx] = rp_flat
+    f2d[row_idx, lane_idx] = f_flat
+    cr2d[row_idx, lane_idx] = cr_flat
+    buf[0:n_pad] = (f2d + 1) | (cr2d << 3) | (cp2d << 4) | (rp2d << 16)
+    v1[row_idx, lane_idx] = v1_flat
+    v2[row_idx, lane_idx] = v2_flat
+
+    # The node -> entry inverse maps stay HOST-side numpy: two
+    # put_along_axis calls for the whole batch (~ms), where the
+    # equivalent XLA scatter in the device prologue compile-blew the
+    # launcher (60s+). The trash row collects every padded entry's
+    # writes in arbitrary order — it is never read. Real rows have
+    # exactly one writer (positions are a permutation).
+    eidx = np.broadcast_to(
+        np.arange(n_pad, dtype=np.int32)[:, None], (n_pad, width))
+    nenic = buf[3 * n_pad:3 * n_pad + m_pad]
+    np.put_along_axis(nenic, cp2d.astype(np.int64), eidx | (1 << 12),
+                      axis=0)
+    np.put_along_axis(nenic, rp2d.astype(np.int64), eidx, axis=0)
+
+    ncomp = np.array([es.n_completed for es in entries_list], np.int32)
+    buf[-1, :n_lanes] = ns.astype(np.int32) | (ncomp << 16)
+    return buf, n_blocks
 
 
 _kernel_cache: dict = {}
 
 
-def _launcher(jm, n_pad: int, max_steps: int, interpret: bool,
-              n_blocks: int):
+def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
+              n_state: int = 1):
     """One jitted pallas_call per (model, shape, blocks) — building the
     call is ~1 s of host tracing, dwarfing the sub-ms kernel, so it
-    must happen once, not per invocation."""
+    must happen once, not per invocation. The step budget is a runtime
+    input, so every cap shares one compiled kernel."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    key = (jm.name, n_pad, max_steps, interpret, n_blocks)
+    key = (jm.name, n_pad, interpret, n_blocks, n_state)
     if key in _kernel_cache:
         return _kernel_cache[key]
 
-    kernel, m_pad = _make_kernel(jm, n_pad, max_steps)
+    uq = not isinstance(jm, mjit.JitModel)
+    key_words = _nw(n_pad) if uq else _nw(n_pad) + 1
+    kernel, m_pad = _make_kernel(jm, n_pad, n_state)
     nw = _nw(n_pad)
 
     def spec(rows):
@@ -355,11 +533,14 @@ def _launcher(jm, n_pad: int, max_steps: int, interpret: bool,
         spec(n_pad), spec(n_pad), spec(n_pad), spec(n_pad),
         spec(n_pad), spec(n_pad),
         spec(m_pad), spec(m_pad), spec(m_pad), spec(m_pad),
-        spec(1), spec(n_pad),
+        spec(1), spec(1),
     ]
     width = n_blocks * LANES
-    out_specs = [spec(1)] * 3
-    out_shape = [jax.ShapeDtypeStruct((1, width), jnp.int32)] * 3
+    out_specs = [spec(1)] * 5 + [spec(n_pad)]
+    out_shape = (
+        [jax.ShapeDtypeStruct((1, width), jnp.int32)] * 5
+        + [jax.ShapeDtypeStruct((n_pad, width), jnp.int32)]
+    )
     call = pl.pallas_call(
         kernel,
         grid=(n_blocks,),
@@ -370,22 +551,50 @@ def _launcher(jm, n_pad: int, max_steps: int, interpret: bool,
             pltpu.VMEM((m_pad, LANES), jnp.int32),   # nxt
             pltpu.VMEM((m_pad, LANES), jnp.int32),   # prv
             pltpu.VMEM((n_pad, LANES), jnp.int32),   # stack_e
-            pltpu.VMEM((n_pad, LANES), jnp.int32),   # stack_s
-            pltpu.VMEM((CACHE_SLOTS, (nw + 1) * LANES), jnp.int32),
+            # stack_s is untouched for the queue (inverse-step
+            # backtracking); keep a token row so the arity is fixed
+            pltpu.VMEM((8 if uq else n_pad, LANES), jnp.int32),
+            pltpu.VMEM((CACHE_SLOTS, key_words * LANES), jnp.int32),
             pltpu.VMEM((CACHE_SLOTS, LANES), jnp.int32),
         ],
         interpret=interpret,
     )
 
     @jax.jit
-    def run(packed):
-        return call(
-            packed["f"], packed["v1"], packed["v2"], packed["crashed"],
-            packed["call_node"], packed["ret_node"],
-            packed["node_entry"], packed["node_is_call"],
-            packed["nxt0"], packed["prv0"], packed["n_completed"],
-            packed["ztab"],
+    def run(buf, msteps):
+        # unpack the single bit-packed transfer buffer (layout in
+        # _pack) and derive the initial linked list on device — all
+        # fused into the dispatch
+        i32 = jnp.int32
+        meta = buf[0:n_pad]
+        f32 = (meta & 7) - 1
+        crashed = (meta >> 3) & 1
+        cp = (meta >> 4) & 0xFFF
+        rp = (meta >> 16) & 0xFFF
+        v1 = buf[n_pad:2 * n_pad]
+        v2 = buf[2 * n_pad:3 * n_pad]
+        nenic = buf[3 * n_pad:3 * n_pad + m_pad]
+        ne = nenic & 0xFFF
+        nic = (nenic >> 12) & 1
+        last = buf[-1:]
+        nn = last & 0xFFFF
+        ncomp = last >> 16
+        w = buf.shape[1]
+        m_iota = jax.lax.broadcasted_iota(i32, (m_pad, w), 0)
+        two_n = 2 * nn
+        nxt0 = jnp.where(m_iota < two_n, m_iota + 1, 0)
+        prv0 = jnp.where((m_iota >= 1) & (m_iota <= two_n), m_iota - 1, 0)
+        verdict, steps, depth, bestd, stuck, beststack = call(
+            f32, v1, v2, crashed,
+            cp, rp, ne, nic, nxt0, prv0, ncomp,
+            msteps,
         )
+        # ONE stacked result array: every host fetch through the
+        # tunnel pays a fixed round trip, so five small arrays cost
+        # ~5x one bigger array (rows: 0 verdict, 1 steps, 2 depth,
+        # 3 best depth, 4 stuck entry, 5.. best stack)
+        return jnp.concatenate(
+            [verdict, steps, depth, bestd, stuck, beststack], axis=0)
 
     _kernel_cache[key] = run
     return run
@@ -408,7 +617,7 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         max_steps = DEFAULT_MAX_STEPS
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    n_pad = max(_next_pow2(max(len(es) for es in entries_list)), 32)
+    n_pad = _pad_size(max(len(es) for es in entries_list))
     if not eligible(jm, n_pad):
         raise ValueError(
             f"pallas-vec path ineligible: model={jm.name} n_pad={n_pad}")
@@ -416,21 +625,60 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         if not jm.lane_eligible(es):
             raise ValueError("lane has no int32 encoding")
 
-    packed, n_blocks = _pack(entries_list, jm, n_pad)
-    run = _launcher(jm, n_pad, max_steps, interpret, n_blocks)
-    verdicts, steps, depths = jax.block_until_ready(run(packed))
-    verdicts = np.asarray(verdicts).reshape(-1)
-    steps = np.asarray(steps).reshape(-1)
+    n_state = _state_pad(jm, entries_list)
 
-    results = []
-    for i, es in enumerate(entries_list):
-        v, s = verdicts[i], int(steps[i])
+    def launch(sub_entries, cap):
+        packed, n_blocks = _pack(sub_entries, jm, n_pad)
+        run = _launcher(jm, n_pad, interpret, n_blocks, n_state)
+        msteps = np.full((1, n_blocks * LANES), cap, np.int32)
+        # ONE numpy fetch of the stacked result: the fetch is also the
+        # completion sync (block_until_ready does not reliably block
+        # for pallas results on the tunnel backend)
+        return np.asarray(run(packed, msteps))
+
+    def result(es, out, i, extra_steps=0):
+        v, s = out[0][i], int(out[1][i]) + extra_steps
         if v == VALID:
-            results.append(WGLResult(valid=True, steps=s))
-        elif v == INVALID:
-            # counterexample recovery host-side, native engine
-            # preferred — same fallback chain as wgl_tpu's invalid path
-            results.append(recover_invalid(model, es))
-        else:
-            results.append(WGLResult(valid="unknown", steps=s))
+            return WGLResult(valid=True, steps=s)
+        if v == INVALID:
+            # the kernel tracked its own counterexample (deepest legal
+            # prefix + stuck entry, wgl_search.cpp:329-341 semantics) —
+            # no host re-search
+            stuck, bestd = int(out[4][i]), int(out[3][i])
+            op = es.invokes[stuck] if stuck >= 0 else None
+            best = [es.invokes[int(e)]
+                    for e in out[5:][: max(0, bestd), i]]
+            return WGLResult(
+                valid=False, op=op, best_linearization=best, steps=s)
+        return WGLResult(valid="unknown", steps=s)
+
+    # Two-pass scheduling: lanes in a 128-wide block run in lockstep,
+    # so ONE deep lane holds its whole block at the full budget —
+    # scattered hard lanes make every block run ~max_steps iterations.
+    # Pass 1 runs everyone under a small cap (most lanes resolve in
+    # hundreds of steps); survivors are repacked DENSELY so only their
+    # few blocks pay the deep budget. Only worth the second dispatch's
+    # fixed round trip (~110ms) when the full budget dwarfs the pass-1
+    # cap and there is more than one block to densify (measured: at a
+    # 4k cap two-pass LOSES ~15%, at 200k it halves the wall).
+    two_pass = (max_steps > 8 * PASS1_CAP
+                and len(entries_list) > LANES)
+    pass1_cap = min(PASS1_CAP, max_steps) if two_pass else max_steps
+    out1 = launch(entries_list, pass1_cap)
+    n = len(entries_list)
+    survivors = [i for i in range(n) if out1[0][i] == UNKNOWN]
+    surv_set = set(survivors)
+    results: list = [None] * n
+    for i, es in enumerate(entries_list):
+        if i not in surv_set:
+            results[i] = result(es, out1, i)
+    if survivors and max_steps > pass1_cap:
+        out2 = launch([entries_list[i] for i in survivors], max_steps)
+        for j, i in enumerate(survivors):
+            # pass-1 work is genuinely spent: report it in the total
+            results[i] = result(entries_list[i], out2, j,
+                                extra_steps=int(out1[1][i]))
+    elif survivors:
+        for i in survivors:
+            results[i] = result(entries_list[i], out1, i)
     return results

@@ -79,14 +79,13 @@ def encode_entries(es: Entries, jm, n_pad: int) -> dict:
     n = len(es)
     assert n <= n_pad
     m = 2 * n_pad + 1
-    codec = jm.lane_codec(es)
     f = np.zeros(n_pad, np.int32)
     v1 = np.full(n_pad, mjit.NIL32, np.int32)
     v2 = np.full(n_pad, mjit.NIL32, np.int32)
-    # payload encoding is genuinely per-op Python; everything else
-    # below is vectorized (encoding dominates batch-path host time)
-    for e in range(n):
-        f[e], v1[e], v2[e] = jm.encode_entry(es.f[e], es.value_out[e], codec)
+    # payload encoding is the only per-op host work left — and for
+    # scalar models it's memoized across lanes (jm.encode_lane)
+    if n > 0:
+        f[:n], v1[:n], v2[:n] = jm.encode_lane(es)
     crashed = np.zeros(n_pad, bool)
     call_node = np.zeros(n_pad, np.int32)
     ret_node = np.zeros(n_pad, np.int32)
@@ -159,7 +158,7 @@ def _mix_hash(h_lin: jnp.ndarray, state: jnp.ndarray,
 
 
 def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
-                max_steps: int, unroll: int = DEFAULT_UNROLL,
+                unroll: int = DEFAULT_UNROLL,
                 dense: bool = False):
     """The complete DFS for one lane. All shapes static.
 
@@ -200,6 +199,9 @@ def _search_one(ent: dict, jm, n_state: int, n_words: int, cache_bits: int,
     cache_size = 1 << cache_bits
     mask = jnp.uint32(cache_size - 1)
     key_width = n_words + (n_state if jm.state_in_key else 0)
+    # runtime input, not a compile-time constant: every step budget
+    # shares one compiled kernel per shape
+    max_steps = ent["max_steps"]
 
     iota_m = lax.iota(jnp.int32, m)
     iota_w = lax.iota(jnp.int32, n_words)
@@ -579,12 +581,12 @@ def _resolve_unroll(unroll: int | None, n_pad: int) -> int:
 
 def build_kernel(jm, n_pad: int, n_state: int = 1,
                  cache_bits: int = DEFAULT_CACHE_BITS,
-                 max_steps: int = DEFAULT_MAX_STEPS,
                  unroll: int | None = None,
                  dense: bool | None = None):
     """A jitted batch kernel for histories padded to n_pad entries with
-    int32[n_state] model state: dict of stacked arrays -> (verdicts,
-    steps, depths), vmapped over the leading lane axis."""
+    int32[n_state] model state: dict of stacked arrays (including a
+    per-lane "max_steps" budget) -> (verdicts, steps, depths), vmapped
+    over the leading lane axis."""
     n_words = max(1, (n_pad + 31) // 32)
     unroll = _resolve_unroll(unroll, n_pad)
     # lane-count-aware dense auto lives in analysis_batch; a direct
@@ -592,7 +594,7 @@ def build_kernel(jm, n_pad: int, n_state: int = 1,
     dense = bool(dense)
 
     def one(ent):
-        return _search_one(ent, jm, n_state, n_words, cache_bits, max_steps,
+        return _search_one(ent, jm, n_state, n_words, cache_bits,
                            unroll, dense)
 
     return jax.jit(jax.vmap(one))
@@ -602,16 +604,17 @@ _kernel_cache: dict = {}
 
 
 def _kernel_for(jm, n_pad: int, n_state: int, cache_bits: int,
-                max_steps: int, unroll: int | None = None,
+                unroll: int | None = None,
                 dense: bool | None = None):
     # normalize before keying so None/False (and None/default unroll)
-    # don't compile the same kernel twice
+    # don't compile the same kernel twice; the step budget is a
+    # runtime input and never keys a compile
     unroll = _resolve_unroll(unroll, n_pad)
     dense = bool(dense)
-    key = (jm.name, n_pad, n_state, cache_bits, max_steps, unroll, dense)
+    key = (jm.name, n_pad, n_state, cache_bits, unroll, dense)
     if key not in _kernel_cache:
         _kernel_cache[key] = build_kernel(
-            jm, n_pad, n_state, cache_bits, max_steps, unroll, dense
+            jm, n_pad, n_state, cache_bits, unroll, dense
         )
     return _kernel_cache[key]
 
@@ -656,43 +659,66 @@ def analysis_batch(
     n_lanes = len(ents)
     if dense is None:
         dense = n_lanes >= DENSE_MIN_LANES and n_pad <= DENSE_MAX_PAD
+    for e in ents:
+        e["max_steps"] = np.int32(max_steps)
     batch = _stack(ents)
 
     devices = devices if devices is not None else jax.devices()
     n_dev = len(devices)
-    pad_lanes = 0
+    # row j of the (possibly permuted, padded) batch -> original lane
+    # index, or -1 for a padding row
+    row_to_lane = list(range(n_lanes))
     if n_dev > 1 and n_lanes >= n_dev:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        pad_lanes = (-n_lanes) % n_dev
-        if pad_lanes:
-            batch = {
-                k: jnp.concatenate(
-                    [v, jnp.repeat(v[-1:], pad_lanes, axis=0)], axis=0
-                )
-                for k, v in batch.items()
-            }
+        # Cost-aware lane scheduling: the sharded axis splits into
+        # CONTIGUOUS per-device chunks, and a device's wall clock is
+        # bounded by its deepest lane — so deal lanes LONGEST-FIRST
+        # round-robin across chunks (entry count is the cheap,
+        # monotone proxy for search depth) instead of shipping them in
+        # arrival order, where a run of deep lanes lands on one
+        # device and serializes the batch. Chunks pad to equal length
+        # with EMPTY lanes (n_completed == 0 -> VALID at init, no
+        # steps), never with copies of a real lane (duplicate work).
+        order = sorted(range(n_lanes),
+                       key=lambda i: -len(entries_list[i]))
+        chunks: list[list[int]] = [[] for _ in range(n_dev)]
+        for j, i in enumerate(order):
+            chunks[j % n_dev].append(i)
+        per = max(len(c) for c in chunks)
+        empty = {k: np.zeros_like(ents[0][k]) for k in ents[0]}
+        rows = []
+        row_to_lane = []
+        for c in chunks:
+            for i in c:
+                rows.append(ents[i])
+                row_to_lane.append(i)
+            for _ in range(per - len(c)):
+                rows.append(empty)
+                row_to_lane.append(-1)
+        batch = _stack(rows)
         mesh = Mesh(np.array(devices), ("keys",))
         sharding = NamedSharding(mesh, P("keys"))
         batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
-    kernel = _kernel_for(jm, n_pad, n_state, cache_bits, max_steps, unroll,
-                         dense)
+    kernel = _kernel_for(jm, n_pad, n_state, cache_bits, unroll, dense)
     verdicts, steps, _depths = jax.block_until_ready(kernel(batch))
-    verdicts = np.asarray(verdicts)[:n_lanes]
-    steps = np.asarray(steps)[:n_lanes]
+    verdicts = np.asarray(verdicts)
+    steps = np.asarray(steps)
 
-    out = []
-    for i, es in enumerate(entries_list):
-        v = int(verdicts[i])
+    out: list = [None] * n_lanes
+    for row, i in enumerate(row_to_lane):
+        if i < 0:
+            continue
+        v = int(verdicts[row])
         valid = {VALID: True, INVALID: False, UNKNOWN: "unknown"}[v]
-        r = WGLResult(valid=valid, steps=int(steps[i]))
+        r = WGLResult(valid=valid, steps=int(steps[row]))
         if valid is False:
             # Recover counterexample details host-side (only failed
             # keys pay this cost; verdicts agree by construction),
             # native engine preferred (wgl_host.recover_invalid).
-            r = recover_invalid(model, es)
-        out.append(r)
+            r = recover_invalid(model, entries_list[i])
+        out[i] = r
     return out
 
 

@@ -6,7 +6,9 @@ a localhost high port with a throwaway keypair, so escaping, sudo
 fallback, upload/download, and ControlMaster reuse are verified
 against real OpenSSH quirks. Skips gracefully when the OpenSSH
 binaries are not installed (this repo's CI image has none — the suite
-must stay green there)."""
+must stay green there). The supported execution path is the docker
+control container, which ships openssh-server for exactly this file:
+see docker/README.md "Running the real-sshd tests"."""
 
 from __future__ import annotations
 

@@ -83,12 +83,33 @@ class TestLiteralHistories:
         hist = random_register_history(n_process=4, n_ops=30, seed=9)
         assert one(CASRegister(), hist, max_steps=1).valid == "unknown"
 
-    def test_queue_model_rejected(self):
+    def test_fifo_queue_rejected(self):
+        from jepsen_tpu.models import FIFOQueue
+
         with pytest.raises(ValueError, match="ineligible"):
             wgl_pallas_vec.analysis_batch(
-                UnorderedQueue(),
+                FIFOQueue(),
                 [make_entries(h(invoke_op(0, "enqueue", 1),
                                 ok_op(0, "enqueue", 1)))])
+
+    def test_unordered_queue_literals(self):
+        m = UnorderedQueue()
+        good = h(
+            invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", "a"),
+        )
+        assert one(m, good).valid is True
+        bad = h(
+            invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", "b"),
+        )
+        assert one(m, bad).valid is False
+        # a crashed enqueue may or may not have landed
+        crashy = h(
+            invoke_op(0, "enqueue", 1), info_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        )
+        assert one(m, crashy).valid is True
 
 
 class TestHostVerdictParity:
@@ -106,6 +127,21 @@ class TestHostVerdictParity:
         for hh, es, r in zip(hists, entries_list, rs):
             hr = wgl_host.analysis(m, es)
             assert r.valid == hr.valid, hh
+
+    @pytest.mark.parametrize("corrupt", [0.0, 0.3])
+    def test_queue_randomized_parity(self, corrupt):
+        from helpers import random_queue_history
+
+        m = UnorderedQueue()
+        hists = [
+            random_queue_history(n_process=4, n_ops=16, n_values=5,
+                                 seed=900 + s, corrupt=corrupt)
+            for s in range(12)
+        ]
+        entries_list = [make_entries(hh) for hh in hists]
+        rs = wgl_pallas_vec.analysis_batch(m, entries_list)
+        for hh, es, r in zip(hists, entries_list, rs):
+            assert r.valid == wgl_host.analysis(m, es).valid, hh
 
     def test_mixed_lane_sizes(self):
         m = CASRegister()
@@ -141,3 +177,51 @@ class TestHostVerdictParity:
         assert wgl_pallas_vec.analysis_batch(CASRegister(), []) == []
         r = one(CASRegister(), h(invoke_op(0, "read"), ok_op(0, "read")))
         assert r.valid is True
+
+
+class TestInKernelCounterexample:
+    """INVALID lanes carry their counterexample out of the kernel
+    (best prefix + stuck entry) — no host re-search. The kernel's
+    bounded cache only ever prunes a SUBSET of what the host's
+    unbounded memo prunes, and first visits happen in the identical
+    DFS order, so the recorded best/stuck must match the host oracle
+    exactly, not just semantically."""
+
+    def test_matches_host_oracle(self):
+        m = CASRegister()
+        found = 0
+        for s in range(30):
+            hist = random_register_history(
+                n_process=4, n_ops=16, seed=4200 + s, corrupt=0.35)
+            es = make_entries(hist)
+            (r,) = wgl_pallas_vec.analysis_batch(m, [es])
+            hr = wgl_host.analysis(m, es)
+            assert r.valid == hr.valid
+            if r.valid is not False:
+                continue
+            found += 1
+            assert (r.op is None) == (hr.op is None)
+            if r.op is not None:
+                assert r.op.index == hr.op.index
+            assert [o.index for o in (r.best_linearization or [])] == \
+                [o.index for o in (hr.best_linearization or [])]
+        assert found >= 3  # the corpus actually exercised the path
+
+    def test_best_prefix_replays_legally(self):
+        """The reported prefix must be a real linearization: replaying
+        it through the host model succeeds step by step."""
+        m = CASRegister()
+        hist = h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "write", 2), ok_op(1, "write", 2),
+            invoke_op(0, "read"), ok_op(0, "read", 99),
+        )
+        es = make_entries(hist)
+        (r,) = wgl_pallas_vec.analysis_batch(m, [es])
+        assert r.valid is False
+        from jepsen_tpu.models import inconsistent
+
+        state = m
+        for op in r.best_linearization:
+            state = state.step_op(op)
+            assert not inconsistent(state), op

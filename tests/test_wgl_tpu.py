@@ -162,11 +162,9 @@ class TestDenseKernelParity:
         chosen = []
         real = wgl_tpu._kernel_for
 
-        def spy(jm, n_pad, n_state, cache_bits, max_steps, unroll,
-                dense=None):
+        def spy(jm, n_pad, n_state, cache_bits, unroll, dense=None):
             chosen.append(dense)
-            return real(jm, n_pad, n_state, cache_bits, max_steps,
-                        unroll, dense)
+            return real(jm, n_pad, n_state, cache_bits, unroll, dense)
 
         monkeypatch.setattr(wgl_tpu, "_kernel_for", spy)
         monkeypatch.setattr(wgl_tpu, "DENSE_MIN_LANES", 4)
