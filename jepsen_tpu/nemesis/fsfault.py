@@ -1,23 +1,42 @@
-"""Filesystem fault-injection nemesis: compiles the native faultfs
-LD_PRELOAD interposer on each node, wraps the system under test so its
-libc I/O goes through it, then injects EIO storms on command.
-
-TPU-era equivalent of the reference's charybdefs layer
-(/root/reference/charybdefs/src/jepsen/charybdefs.clj:1-86): same
+"""Filesystem fault-injection nemesis with TWO backends sharing one
 control surface — break-all (every op fails EIO), break-one-percent
-(~1% fail), clear — but implemented as in-process interposition scoped
-to the DB's data directory instead of a thrift-driven FUSE mount, so it
-needs no kernel module, no /faulty remount, and no thrift toolchain on
-the nodes.
+(~1% fail), clear — the TPU-era equivalent of the reference's
+charybdefs layer (/root/reference/charybdefs/src/jepsen/
+charybdefs.clj:1-86):
 
-Use:
+1. **fuse** (charybdefs parity): `native/faultfs_fuse.cpp`, a FUSE
+   passthrough filesystem speaking the raw kernel protocol over
+   /dev/fuse (no libfuse, no thrift), mounted OVER the DB's data dir
+   with the original directory as backing store. Faults any process's
+   I/O — including STATICALLY LINKED executables (etcd, consul,
+   cockroach, dgraph: most Go binaries) — because the fault lives
+   below the VFS boundary, exactly like the reference's FUSE mount
+   (charybdefs.clj:40-65). Needs root (the daemon calls mount(2)) and
+   /dev/fuse on the node.
+
+2. **preload**: `native/faultfs.cpp`, an LD_PRELOAD libc interposer
+   wrapped around the DB binary, scoped to a path prefix. No mount,
+   no /dev/fuse, works in unprivileged containers — BUT it is a
+   silent no-op for statically linked executables, which never go
+   through the dynamic loader. `wrap()` probes the target's ELF
+   headers and REFUSES static binaries loudly rather than injecting
+   nothing; route those through the fuse backend instead.
+
+Use (fuse, the default where it can run):
+    fsfault.install_fuse(remote, node)         # compile faultfs_fuse
+    fsfault.mount_fuse(remote, node, "/opt/db/data")
+    ... start the DB; its data dir is now fault-injectable ...
+    nemesis = fsfault.fs_fault_nemesis(backend="fuse",
+                                       data_dir_fn=...)
+
+Use (preload):
     fsfault.install(remote, node)              # compile libfaultfs.so
     fsfault.wrap(remote, node, "/opt/db/bin", prefix="/opt/db/data")
-    ... start the DB through its normal daemon path ...
     nemesis = fsfault.fs_fault_nemesis(prefix_fn)
-with nemesis ops {"f": "break-all"|"break-one-percent"|"clear"},
-or the start/stop convention: start == break (mode from the op's
-value or the nemesis default), stop == clear.
+
+Nemesis ops: {"f": "break-all"|"break-one-percent"|"clear"}, or the
+start/stop convention: start == break (mode from the op's value or
+the nemesis default), stop == clear.
 """
 
 from __future__ import annotations
@@ -127,11 +146,176 @@ def clear(remote: Remote, node, opt_dir: str = OPT_DIR) -> None:
     _write_ctl(remote, node, "off\n", opt_dir)
 
 
+FUSE_BIN = "faultfs_fuse"
+
+
+def fuse_bin_path(opt_dir: str = OPT_DIR) -> str:
+    return f"{opt_dir}/{FUSE_BIN}"
+
+
+def compile_fuse(remote: Remote, node, opt_dir: str = OPT_DIR) -> str:
+    """Upload faultfs_fuse.cpp and build the FUSE daemon on the node
+    (charybdefs builds its FUSE binary on-node too,
+    charybdefs.clj:40-65). Idempotent via a source-hash stamp, atomic
+    via mv — same discipline as compile_lib."""
+    import hashlib
+
+    src = os.path.join(_NATIVE_DIR, "faultfs_fuse.cpp")
+    with open(src, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    stamp = f"{opt_dir}/faultfs_fuse.src.{digest}"
+    remote.exec(node, ["mkdir", "-p", opt_dir], sudo=True)
+    remote.exec(node, ["chmod", "a+rwx", opt_dir], sudo=True)
+    if exists(remote, node, stamp) and exists(remote, node,
+                                              fuse_bin_path(opt_dir)):
+        return fuse_bin_path(opt_dir)
+    remote.upload(node, src, f"{opt_dir}/faultfs_fuse.cpp")
+    remote.exec(
+        node,
+        ["g++", "-O2", "-o", f"{FUSE_BIN}.tmp", "faultfs_fuse.cpp"],
+        cd=opt_dir, sudo=True,
+    )
+    remote.exec(node, ["mv", "-f", f"{opt_dir}/{FUSE_BIN}.tmp",
+                       fuse_bin_path(opt_dir)], sudo=True)
+    remote.exec(node, f"rm -f {opt_dir}/faultfs_fuse.src.*", check=False)
+    remote.exec(node, ["touch", stamp], sudo=True)
+    return fuse_bin_path(opt_dir)
+
+
+def install_fuse(remote: Remote, node, opt_dir: str = OPT_DIR) -> None:
+    """Build the FUSE daemon; install a compiler and retry on failure
+    (mirrors install())."""
+    try:
+        compile_fuse(remote, node, opt_dir)
+    except RemoteError:
+        try:
+            osdist.install(remote, node, ["build-essential"])
+        except RemoteError:
+            osdist.centos_install(remote, node, ["gcc-c++"])
+        compile_fuse(remote, node, opt_dir)
+    clear(remote, node, opt_dir)
+
+
+def backing_dir(data_dir: str) -> str:
+    return data_dir.rstrip("/") + ".faultfs-backing"
+
+
+def mount_fuse(remote: Remote, node, data_dir: str,
+               opt_dir: str = OPT_DIR) -> None:
+    """Interpose the FUSE layer over `data_dir`: the real directory
+    moves aside to <data_dir>.faultfs-backing and faultfs_fuse mounts
+    at the original path (the charybdefs /faulty analog, but in place
+    — the DB's configuration never changes). Idempotent. The daemon
+    requires root and /dev/fuse; a missing /dev/fuse fails loudly
+    here rather than as a hung daemon."""
+    if not exists(remote, node, "/dev/fuse"):
+        raise RemoteError(
+            f"no /dev/fuse on {node}: the fuse backend cannot run "
+            "(use the preload backend for dynamically linked targets, "
+            "or load the fuse kernel module)")
+    back = backing_dir(data_dir)
+    if not exists(remote, node, back):
+        remote.exec(node, ["mv", data_dir, back], sudo=True)
+        remote.exec(node, ["mkdir", "-p", data_dir], sudo=True)
+        # the mountpoint's OWN perms only matter unmounted; match the
+        # backing dir so a crashed daemon degrades gracefully
+        remote.exec(node, ["chmod", "--reference", back, data_dir],
+                    sudo=True, check=False)
+        remote.exec(node, ["chown", "--reference", back, data_dir],
+                    sudo=True, check=False)
+    # already mounted? (idempotence for retried setups)
+    try:
+        remote.exec(node, ["mountpoint", "-q", data_dir], sudo=True)
+        return
+    except RemoteError:
+        pass
+    remote.exec(node, [fuse_bin_path(opt_dir), back, data_dir,
+                       ctl_path(opt_dir)], sudo=True)
+
+
+def umount_fuse(remote: Remote, node, data_dir: str) -> None:
+    """Tear the FUSE layer down and put the real directory back.
+    The restore only runs once the mount is REALLY gone: with a busy
+    mount still up, `mv backing data_dir` would target the live FUSE
+    fs whose backing store is the source itself — stranding the real
+    data. A busy mount gets a lazy (detached) unmount and a re-check."""
+    back = backing_dir(data_dir)
+
+    def mounted() -> bool:
+        try:
+            remote.exec(node, ["mountpoint", "-q", data_dir], sudo=True)
+            return True
+        except RemoteError:
+            return False
+
+    remote.exec(node, ["umount", data_dir], sudo=True, check=False)
+    if mounted():
+        remote.exec(node, ["umount", "-l", data_dir], sudo=True,
+                    check=False)
+        if mounted():
+            raise RemoteError(
+                f"{node}: {data_dir} is still mounted after umount -l; "
+                f"refusing to restore {back} over a live mount")
+    if exists(remote, node, back):
+        remote.exec(node, ["rmdir", data_dir], sudo=True, check=False)
+        remote.exec(node, ["mv", back, data_dir], sudo=True)
+
+
+def is_static(remote: Remote, node, cmd: str) -> bool | None:
+    """True if `cmd` is a statically linked ELF (no PT_INTERP), False
+    if dynamic, None if undeterminable (no readelf on the node and no
+    usable fallback)."""
+    try:
+        # not an ELF at all (a #! script, e.g. the hermetic sims):
+        # what executes is the INTERPRETER, which is dynamically
+        # linked — LD_PRELOAD interposes fine
+        magic = remote.exec(node, f"head -c 4 {cmd} | od -An -tx1").out
+        if "7f 45 4c 46" not in magic:
+            return False
+    except RemoteError:
+        pass
+    try:
+        out = remote.exec(node, ["readelf", "-l", cmd], sudo=True).out
+        if "Program Headers" in out or "INTERP" in out:
+            return "INTERP" not in out
+    except RemoteError:
+        pass
+    try:
+        # ldd prints "not a dynamic executable" on static binaries
+        # (and exits nonzero on some distros — capture either way)
+        out = remote.exec(node, f"ldd {cmd} 2>&1 || true").out
+        if "not a dynamic executable" in out.lower():
+            return True
+        if "=>" in out or "linux-vdso" in out:
+            return False
+    except RemoteError:
+        pass
+    return None
+
+
 def wrap(remote: Remote, node, cmd: str, prefix: str = "",
          opt_dir: str = OPT_DIR) -> None:
     """Replace executable `cmd` with a wrapper that launches the
     original under LD_PRELOAD=libfaultfs.so, keeping the original at
-    cmd.no-faultfs; idempotent (the faketime.wrap pattern)."""
+    cmd.no-faultfs; idempotent (the faketime.wrap pattern).
+
+    REFUSES statically linked targets: LD_PRELOAD interposition rides
+    the dynamic loader, so on a static binary (etcd, consul,
+    cockroach — most Go executables) it silently injects NOTHING and
+    every fault op becomes a vacuous no-op. Those targets need the
+    fuse backend (mount_fuse), which faults below the VFS boundary."""
+    st = is_static(remote, node, cmd)
+    if st is True:
+        raise RemoteError(
+            f"{node}: {cmd} is statically linked: the LD_PRELOAD "
+            "faultfs backend cannot interpose it (the dynamic loader "
+            "never runs) — use the fuse backend (fsfault.mount_fuse "
+            "over the data dir) instead")
+    if st is None:
+        log.warning(
+            "%s: cannot determine whether %s is statically linked "
+            "(no readelf/ldd); LD_PRELOAD faults will be silent "
+            "no-ops if it is", node, cmd)
     orig = f"{cmd}.no-faultfs"
     wrapper = (
         "#!/bin/sh\n"
@@ -162,19 +346,39 @@ class FsFaultNemesis(Nemesis):
         {"f": "start"}              alias for the default break mode
         {"f": "stop"}               alias for clear
 
-    prefix_fn(test, node) -> path scopes faults to the system under
-    test's data directory (the charybdefs /faulty mount analog)."""
+    backend="preload": prefix_fn(test, node) -> path scopes faults to
+    the system under test's data directory; the suite must have
+    wrap()ed the (dynamically linked) binary.
+
+    backend="fuse": data_dir_fn(test, node) -> the data directory to
+    interpose; setup compiles the daemon and mounts it over the dir
+    (do this BEFORE the DB starts), teardown unmounts and restores.
+    Works against any process, including static binaries
+    (charybdefs.clj:40-85 parity)."""
 
     def __init__(self, prefix_fn=None, default_mode: str = "break-all",
-                 opt_dir: str = OPT_DIR):
+                 opt_dir: str = OPT_DIR, backend: str = "preload",
+                 data_dir_fn=None):
+        assert backend in ("preload", "fuse"), backend
+        if backend == "fuse" and data_dir_fn is None:
+            raise ValueError("fuse backend needs data_dir_fn")
         self.prefix_fn = prefix_fn or (lambda test, node: "")
         self.default_mode = default_mode
         self.opt_dir = opt_dir
+        self.backend = backend
+        self.data_dir_fn = data_dir_fn
 
     def setup(self, test):
         remote = test["remote"]
-        real_pmap(lambda n: install(remote, n, self.opt_dir),
-                  test["nodes"])
+        if self.backend == "fuse":
+            def up(n):
+                install_fuse(remote, n, self.opt_dir)
+                mount_fuse(remote, n, self.data_dir_fn(test, n),
+                           self.opt_dir)
+            real_pmap(up, test["nodes"])
+        else:
+            real_pmap(lambda n: install(remote, n, self.opt_dir),
+                      test["nodes"])
         return self
 
     def invoke(self, test, op):
@@ -212,8 +416,18 @@ class FsFaultNemesis(Nemesis):
             except RemoteError:
                 log.warning("fsfault clear failed on %s", node,
                             exc_info=True)
+            if self.backend == "fuse":
+                try:
+                    umount_fuse(remote, node,
+                                self.data_dir_fn(test, node))
+                except RemoteError:
+                    log.warning("faultfs unmount failed on %s", node,
+                                exc_info=True)
 
 
 def fs_fault_nemesis(prefix_fn=None,
-                     default_mode: str = "break-all") -> FsFaultNemesis:
-    return FsFaultNemesis(prefix_fn, default_mode)
+                     default_mode: str = "break-all",
+                     backend: str = "preload",
+                     data_dir_fn=None) -> FsFaultNemesis:
+    return FsFaultNemesis(prefix_fn, default_mode, backend=backend,
+                          data_dir_fn=data_dir_fn)

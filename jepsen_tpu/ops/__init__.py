@@ -5,11 +5,14 @@ wgl_host — Wing-Gong-Lowe linearizability search on host (semantics
 wgl_tpu  — the same search as a jitted bitmask-DFS over int32 tensors,
           vmapped over independent keys and sharded over a device mesh.
 
-Importing this package configures JAX's persistent compilation cache
-(before any kernel compiles): search-kernel variants cost seconds to
-tens of seconds of XLA/Mosaic compile each, and a fresh process pays
-all of them again without a disk cache. Override the location with
-JEPSEN_TPU_COMPILE_CACHE (set to "off" to disable)."""
+Importing a KERNEL module (wgl_tpu / wgl_pallas / wgl_pallas_vec)
+configures JAX's persistent compilation cache before any kernel
+compiles: search-kernel variants cost seconds to tens of seconds of
+XLA/Mosaic compile each, and a fresh process pays all of them again
+without a disk cache. The package import itself stays jax-free so
+pure-host consumers (wgl_host, the control plane) don't pay a jax
+import. Override the location with JEPSEN_TPU_COMPILE_CACHE (set to
+"off" to disable)."""
 
 import os as _os
 
@@ -39,6 +42,3 @@ def _configure_compilation_cache() -> None:
                           0.5)
     except Exception:  # noqa: BLE001 — older jax or read-only home
         pass
-
-
-_configure_compilation_cache()

@@ -475,6 +475,16 @@ def _pack(entries_list, jm, n_pad: int) -> tuple[dict, int]:
     lane_idx = np.repeat(np.arange(n_lanes), ns)
     row_idx = np.arange(total) - np.repeat(np.cumsum(ns) - ns, ns)
 
+    # Duplicate call/ret positions would silently corrupt the node-map
+    # scatters below (last-writer-wins). history.entries guarantees a
+    # per-lane permutation; guard it here since this fast path no
+    # longer goes through encode_entries' assert.
+    occ = np.bincount(
+        np.concatenate([lane_idx, lane_idx]) * np.int64(m_pad)
+        + np.concatenate([cp_flat, rp_flat]).astype(np.int64))
+    assert occ.max(initial=0) <= 1, \
+        "duplicate call/ret node positions in Entries"
+
     cp2d = np.full((n_pad, width), m_pad - 1, np.int32)
     rp2d = np.full((n_pad, width), m_pad - 1, np.int32)
     f2d = np.full((n_pad, width), -1, np.int32)  # padded: never lin

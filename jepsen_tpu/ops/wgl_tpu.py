@@ -43,10 +43,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import _configure_compilation_cache
 from ..history import Entries
 from ..models import jit as mjit
 from .wgl_host import (WGLResult, analysis as wgl_host_analysis,
                        recover_invalid)
+
+# before any kernel compiles (see ops/__init__ docstring) — here, not
+# at package import, so pure-host consumers never pay an eager jax
+_configure_compilation_cache()
 
 # verdict codes
 RUNNING, VALID, INVALID, UNKNOWN = 0, 1, 2, 3
