@@ -105,11 +105,22 @@ class EtcdDB(db.DB, db.LogFiles):
         )
 
     def setup(self, test, node) -> None:
+        self.install(test, node)
+        self.start(test, node)
+
+    def install(self, test, node) -> None:
+        """Fetch + unpack only — split from start so interposers (the
+        faultfs FUSE layer) can mount over the data dir BETWEEN
+        install's tree wipe and the daemon opening its files."""
         remote = test["remote"]
         d = node_dir(test, node)
         sudo = _cfg(test).get("sudo", True)
         log.info("%s installing etcd %s", node, self.version)
         cu.install_archive(remote, node, self.archive_url(), d, sudo=sudo)
+
+    def start(self, test, node) -> None:
+        remote = test["remote"]
+        d = node_dir(test, node)
         cu.start_daemon(
             remote, node, f"{d}/{BINARY}",
             "--name", str(node),
@@ -290,11 +301,37 @@ def cas(test, process):
 # ---------------------------------------------------------------------------
 # Test map (etcd.clj:149-181)
 
+def data_dir(test, node) -> str:
+    """etcd's default data dir: <name>.etcd under its cwd (we start
+    the daemon with chdir=node_dir and no --data-dir flag)."""
+    return f"{node_dir(test, node)}/{node}.etcd"
+
+
 def etcd_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
     db_ = EtcdDB(opts.get("version", VERSION),
                  url=opts.get("archive_url"))
+    # fs-break* modes interpose the FUSE fault layer around the data
+    # dir: the DB wrapper owns the mount (it must precede the daemon),
+    # the nemesis only flips the fault switch — etcd is statically
+    # linked Go, so the LD_PRELOAD backend can't touch it
+    nemesis_name = opts.get("nemesis") or ""
+    if nemesis_name.startswith("fs-break"):
+        from ..nemesis import fsfault
+
+        # ONE opt_dir for both the mount owner and the switch flipper:
+        # they share the control file, and diverging dirs would make
+        # every break/clear a silent no-op
+        fs_opt = opts.get("fsfault_opt_dir", fsfault.OPT_DIR)
+        db_ = fsfault.FaultFsDB(db_, data_dir, opt_dir=fs_opt)
+        nemesis_ = fsfault.fs_fault_nemesis(
+            backend="fuse", manage_mounts=False, opt_dir=fs_opt,
+            default_mode=("break-one-percent"
+                          if nemesis_name == "fs-break-1pct"
+                          else "break-all"))
+    else:
+        nemesis_ = cmn.pick_nemesis(db_, opts)
     test = noop_test()
     per_key = opts.get("ops_per_key", 300)
     threads_per_key = opts.get("threads_per_key", 10)
@@ -304,7 +341,7 @@ def etcd_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": EtcdClient(),
-            "nemesis": cmn.pick_nemesis(db_, opts),
+            "nemesis": nemesis_,
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -335,15 +372,18 @@ def etcd_test(opts: dict) -> dict:
         }
     )
     # The reference merges opts last (etcd.clj:152,181) so CLI options
-    # like nodes/ssh/concurrency override suite defaults.
+    # like nodes/ssh/concurrency override suite defaults. "nemesis" is
+    # consumed above (resolved into a nemesis OBJECT) — merging the raw
+    # string back over it would hand core.run a str.
     consumed = {"version", "archive_url", "ops_per_key", "threads_per_key",
-                "time_limit"}
+                "time_limit", "nemesis", "fsfault_opt_dir"}
     test.update({k: v for k, v in opts.items() if k not in consumed})
     return test
 
 
 def _opt_spec(p) -> None:
-    cmn.nemesis_opt(p, names=cmn.PARTITION_NEMESIS_NAMES)
+    cmn.nemesis_opt(p, names=cmn.PARTITION_NEMESIS_NAMES
+                    + ("fs-break", "fs-break-1pct"))
 
 
 def main(argv=None) -> None:

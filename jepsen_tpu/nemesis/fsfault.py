@@ -215,7 +215,12 @@ def mount_fuse(remote: Remote, node, data_dir: str,
             "or load the fuse kernel module)")
     back = backing_dir(data_dir)
     if not exists(remote, node, back):
-        remote.exec(node, ["mv", data_dir, back], sudo=True)
+        if exists(remote, node, data_dir):
+            remote.exec(node, ["mv", data_dir, back], sudo=True)
+        else:
+            # fresh node (a db cycle wiped the tree): the DB will
+            # populate the dir THROUGH the mount
+            remote.exec(node, ["mkdir", "-p", back], sudo=True)
         remote.exec(node, ["mkdir", "-p", data_dir], sudo=True)
         # the mountpoint's OWN perms only matter unmounted; match the
         # backing dir so a crashed daemon degrades gracefully
@@ -259,6 +264,79 @@ def umount_fuse(remote: Remote, node, data_dir: str) -> None:
     if exists(remote, node, back):
         remote.exec(node, ["rmdir", data_dir], sudo=True, check=False)
         remote.exec(node, ["mv", back, data_dir], sudo=True)
+
+
+from .. import db as db_mod
+
+
+class FaultFsDB(db_mod.DB, db_mod.LogFiles):
+    """DB wrapper that interposes the FUSE fault layer around an inner
+    DB's lifecycle: mount BEFORE the daemon starts (its data dir must
+    not move underneath live file descriptors), unmount after
+    teardown. This mirrors how the reference integrates charybdefs —
+    as part of the DB stack, not the nemesis (charybdefs.clj:40-65
+    runs at db setup time); the nemesis then only flips the fault
+    switch (FsFaultNemesis(manage_mounts=False)).
+
+    Subclasses DB + LogFiles so isinstance-dispatched capabilities
+    (core's log snarfing above all — EIO-storm runs are exactly where
+    the daemon logs matter) keep working through the wrapper;
+    log_files delegates, returning [] for inner DBs without the
+    mixin. Primary/ArchiveDB-specific dispatch (setup_primary, the
+    kill/pause registry) does NOT pass through isinstance checks —
+    wire those against the INNER db directly.
+
+    Use:
+        db = fsfault.FaultFsDB(EtcdDB(...), data_dir_fn)
+    """
+
+    def __init__(self, inner, data_dir_fn, opt_dir: str = OPT_DIR):
+        self.inner = inner
+        self.data_dir_fn = data_dir_fn
+        self.opt_dir = opt_dir
+
+    def log_files(self, test, node) -> list:
+        if isinstance(self.inner, db_mod.LogFiles):
+            return self.inner.log_files(test, node)
+        return []
+
+    def setup(self, test, node) -> None:
+        remote = test["remote"]
+        install_fuse(remote, node, self.opt_dir)
+        inner_install = getattr(self.inner, "install", None)
+        inner_start = getattr(self.inner, "start", None)
+        if inner_install and inner_start:
+            # the right interposition point: after install's tree wipe,
+            # before the daemon opens any file (a post-start mount
+            # would miss every fd the daemon already holds)
+            inner_install(test, node)
+            mount_fuse(remote, node, self.data_dir_fn(test, node),
+                       self.opt_dir)
+            inner_start(test, node)
+        else:
+            # no install/start split: the data dir must live OUTSIDE
+            # the inner DB's install tree, or its setup will collide
+            # with the live mountpoint
+            mount_fuse(remote, node, self.data_dir_fn(test, node),
+                       self.opt_dir)
+            self.inner.setup(test, node)
+
+    def teardown(self, test, node) -> None:
+        # unmount FIRST: the inner teardown's tree wipe cannot remove
+        # a live mountpoint (EBUSY). umount_fuse falls back to a lazy
+        # detach while the daemon still holds fds, then restores the
+        # backing dir; the inner teardown then wipes the real tree.
+        try:
+            umount_fuse(remote=test["remote"], node=node,
+                        data_dir=self.data_dir_fn(test, node))
+        except RemoteError:
+            log.warning("faultfs unmount failed on %s", node,
+                        exc_info=True)
+        self.inner.teardown(test, node)
+
+    def __getattr__(self, name):
+        # LogFiles / Primary / kill hooks etc. pass through untouched
+        return getattr(self.inner, name)
 
 
 def is_static(remote: Remote, node, cmd: str) -> bool | None:
@@ -351,31 +429,40 @@ class FsFaultNemesis(Nemesis):
     wrap()ed the (dynamically linked) binary.
 
     backend="fuse": data_dir_fn(test, node) -> the data directory to
-    interpose; setup compiles the daemon and mounts it over the dir
-    (do this BEFORE the DB starts), teardown unmounts and restores.
-    Works against any process, including static binaries
-    (charybdefs.clj:40-85 parity)."""
+    interpose; setup compiles the daemon and mounts it over the dir,
+    teardown unmounts and restores. Works against any process,
+    including static binaries (charybdefs.clj:40-85 parity). NOTE the
+    standard run lifecycle starts the DB before nemesis setup — for
+    real suites wrap the DB in FaultFsDB (which owns the mount) and
+    pass manage_mounts=False here so this nemesis only flips the
+    fault switch; manage_mounts=True is for harnesses that bring the
+    DB up after the nemesis."""
 
     def __init__(self, prefix_fn=None, default_mode: str = "break-all",
                  opt_dir: str = OPT_DIR, backend: str = "preload",
-                 data_dir_fn=None):
+                 data_dir_fn=None, manage_mounts: bool = True):
         assert backend in ("preload", "fuse"), backend
-        if backend == "fuse" and data_dir_fn is None:
+        if backend == "fuse" and manage_mounts and data_dir_fn is None:
             raise ValueError("fuse backend needs data_dir_fn")
         self.prefix_fn = prefix_fn or (lambda test, node: "")
         self.default_mode = default_mode
         self.opt_dir = opt_dir
         self.backend = backend
         self.data_dir_fn = data_dir_fn
+        self.manage_mounts = manage_mounts
 
     def setup(self, test):
         remote = test["remote"]
         if self.backend == "fuse":
-            def up(n):
-                install_fuse(remote, n, self.opt_dir)
-                mount_fuse(remote, n, self.data_dir_fn(test, n),
-                           self.opt_dir)
-            real_pmap(up, test["nodes"])
+            if self.manage_mounts:
+                def up(n):
+                    install_fuse(remote, n, self.opt_dir)
+                    mount_fuse(remote, n, self.data_dir_fn(test, n),
+                               self.opt_dir)
+                real_pmap(up, test["nodes"])
+            else:  # FaultFsDB owns the mounts; start healed
+                real_pmap(lambda n: clear(remote, n, self.opt_dir),
+                          test["nodes"])
         else:
             real_pmap(lambda n: install(remote, n, self.opt_dir),
                       test["nodes"])
@@ -416,7 +503,7 @@ class FsFaultNemesis(Nemesis):
             except RemoteError:
                 log.warning("fsfault clear failed on %s", node,
                             exc_info=True)
-            if self.backend == "fuse":
+            if self.backend == "fuse" and self.manage_mounts:
                 try:
                     umount_fuse(remote, node,
                                 self.data_dir_fn(test, node))
@@ -428,6 +515,9 @@ class FsFaultNemesis(Nemesis):
 def fs_fault_nemesis(prefix_fn=None,
                      default_mode: str = "break-all",
                      backend: str = "preload",
-                     data_dir_fn=None) -> FsFaultNemesis:
-    return FsFaultNemesis(prefix_fn, default_mode, backend=backend,
-                          data_dir_fn=data_dir_fn)
+                     data_dir_fn=None,
+                     manage_mounts: bool = True,
+                     opt_dir: str = OPT_DIR) -> FsFaultNemesis:
+    return FsFaultNemesis(prefix_fn, default_mode, opt_dir=opt_dir,
+                          backend=backend, data_dir_fn=data_dir_fn,
+                          manage_mounts=manage_mounts)

@@ -1,0 +1,105 @@
+"""The FUSE fault backend through the etcd SUITE surface: `--nemesis
+fs-break` wraps the DB in FaultFsDB (mount precedes the daemon, like
+the reference's charybdefs-at-db-setup, charybdefs.clj:40-65), the
+nemesis only flips the fault switch, and the engine runs a full test
+with EIO storms mid-run. The sim's shared state file lives INSIDE the
+interposed data dir, so storms genuinely break the DB's I/O.
+
+Needs root + /dev/fuse + g++ (same envelope as test_fsfault_fuse)."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import shutil
+
+import pytest
+
+from jepsen_tpu import core, generator as gen, independent
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.dbs import etcd, etcd_sim
+from jepsen_tpu.nemesis import fsfault
+from tests.helpers import free_port
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None
+    or not os.path.exists("/dev/fuse")
+    or os.geteuid() != 0,
+    reason="needs g++, /dev/fuse, and root",
+)
+
+
+def test_etcd_suite_fs_break_end_to_end(tmp_path):
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    etcd_dir = os.path.join(remote.node_dir("n1"), "opt", "etcd")
+    # the sim's state file lives in etcd's data dir — the directory
+    # FaultFsDB will interpose — so EIO storms hit the DB's real I/O
+    data_dir = os.path.join(etcd_dir, "n1.etcd")
+    os.makedirs(data_dir, exist_ok=True)
+    archive = str(tmp_path / "etcd-sim.tar.gz")
+    etcd_sim.build_archive(archive,
+                           os.path.join(data_dir, "state.json"))
+
+    opt_dir = os.path.join(remote.node_dir("n1"), "opt", "jepsen")
+    opts = {
+        "nemesis": "fs-break",
+        "archive_url": f"file://{archive}",
+        "version": "sim",
+        "time_limit": 10,
+        "threads_per_key": 3,
+        "fsfault_opt_dir": opt_dir,
+    }
+    test = etcd.etcd_test(opts)
+    assert isinstance(test["db"], fsfault.FaultFsDB)
+    # snarf-ability survives the wrapper (EIO runs need the logs most)
+    from jepsen_tpu import db as db_mod
+    assert isinstance(test["db"], db_mod.LogFiles)
+    assert test["db"].log_files(
+        {"remote": remote, "etcd": {"dir": lambda n: etcd_dir}}, "n1")
+    test.update({
+        "nodes": ["n1"],
+        "remote": remote,
+        "os": None,
+        "net": None,
+        "concurrency": 3,
+        "etcd": {
+            "addr_fn": lambda n: "127.0.0.1",
+            "client_ports": {"n1": free_port()},
+            "peer_ports": {"n1": free_port()},
+            "dir": lambda n: etcd_dir,
+            "sudo": None,
+        },
+    })
+    def client_phase(key_start):
+        return gen.time_limit(2, gen.clients(
+            independent.concurrent_generator(
+                3, itertools.count(key_start),
+                lambda k: gen.limit(15, gen.stagger(
+                    0.01, gen.mix([etcd.r, etcd.w, etcd.cas]))))))
+
+    test["generator"] = gen.phases(
+        client_phase(0),
+        gen.nemesis(gen.once({"type": "info", "f": "start"})),
+        client_phase(100),
+        gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+        client_phase(200),
+    )
+
+    result = core.run(test)
+    hist = result["history"]
+    res = result["results"]
+    # sound verdict despite the storm (EIO fails ops; never lies)
+    assert res["valid"] in (True, "unknown"), res
+    # the mount came and went with the DB lifecycle
+    assert not os.path.exists(fsfault.backing_dir(data_dir))
+    import subprocess
+    assert subprocess.run(["mountpoint", "-q", data_dir]).returncode != 0
+    # the storm bit: client ops errored while broken
+    nem_ops = [o for o in hist if o.process == "nemesis"]
+    assert any(o.f in ("break-all", "start") for o in nem_ops), nem_ops
+    errs = [o for o in hist
+            if o.process != "nemesis" and o.type in ("fail", "info")]
+    assert errs, "EIO storm produced no failed/indeterminate client ops"
+    # and the healed phase recovered: the tail has successful ops
+    tail_ok = [o for o in hist[-60:] if o.type == "ok"]
+    assert tail_ok, "no successful ops after heal"
