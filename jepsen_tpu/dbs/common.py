@@ -90,10 +90,20 @@ class ArchiveDB(db.DB, db.LogFiles):
         )
 
     def setup(self, test, node) -> None:
+        self.install(test, node)
+        self.start_and_await(test, node)
+
+    def install(self, test, node) -> None:
+        """Fetch + unpack only — split from start_and_await so
+        interposers (the faultfs FUSE layer) can mount over the data
+        dir between the install's tree wipe and the daemon opening
+        its first file (fsfault.FaultFsDB)."""
         remote = test["remote"]
         d = self.suite.dir(test, node)
         cu.install_archive(remote, node, self.resolve_url(test), d,
                            sudo=self.suite.sudo(test))
+
+    def start_and_await(self, test, node) -> None:
         self.start(test, node)
         self.await_ready(test, node)
         self.post_start(test, node)
@@ -479,6 +489,32 @@ def pick_nemesis(db, opts: dict, default: str = "parts", extra=None):
             f"nemesis {name!r} not available for this suite "
             f"(have: {sorted(registry)})")
     return registry[name]()
+
+
+FSFAULT_NEMESIS_NAMES = ("fs-break", "fs-break-1pct")
+
+
+def fsfault_wiring(db_, opts: dict, data_dir_fn):
+    """(db, nemesis) for the --nemesis fs-break modes, else
+    (db, None). The DB wraps in FaultFsDB — the mount must happen
+    between install and daemon start — and the nemesis only flips the
+    shared fault switch; ONE opt_dir (opts['fsfault_opt_dir']) feeds
+    both, since diverging control-file paths would make every
+    break/clear a silent no-op. Suites add FSFAULT_NEMESIS_NAMES to
+    their nemesis_opt choices and consume 'fsfault_opt_dir' in their
+    merge-opts-last step."""
+    name = opts.get("nemesis") or ""
+    if not name.startswith("fs-break"):
+        return db_, None
+    from ..nemesis import fsfault
+
+    fs_opt = opts.get("fsfault_opt_dir", fsfault.OPT_DIR)
+    wrapped = fsfault.FaultFsDB(db_, data_dir_fn, opt_dir=fs_opt)
+    nem = fsfault.fs_fault_nemesis(
+        backend="fuse", manage_mounts=False, opt_dir=fs_opt,
+        default_mode=("break-one-percent" if name == "fs-break-1pct"
+                      else "break-all"))
+    return wrapped, nem
 
 
 def nemesis_opt(p, names=NEMESIS_NAMES, default: str = "parts") -> None:

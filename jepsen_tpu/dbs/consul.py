@@ -61,9 +61,10 @@ class ConsulDB(ArchiveDB):
         primary = test["nodes"][0]
         extra = (["-bootstrap"] if node == primary
                  else ["-join", node_host(test, primary)])
-        d = _suite.dir(test, node)
+        # data_dir() is the single source of truth — the faultfs FUSE
+        # layer mounts over exactly this path
         return ["agent", "-server", "-node", str(node),
-                "-data-dir", f"{d}/data", "-client", "0.0.0.0",
+                "-data-dir", data_dir(test, node), "-client", "0.0.0.0",
                 "-http-port", str(node_port(test, node)), *extra]
 
     def probe_ready(self, test, node) -> bool:
@@ -168,10 +169,20 @@ def cas(test, process):
             "value": (random.randrange(5), random.randrange(5))}
 
 
+def data_dir(test, node) -> str:
+    """The agent's -data-dir (daemon_args passes {dir}/data)."""
+    return f"{_suite.dir(test, node)}/data"
+
+
 def consul_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
     db_ = ConsulDB(archive_url=opts.get("archive_url"))
+    # consul is a statically linked Go binary: the charybdefs-analog
+    # fault modes need the FUSE backend (cmn.fsfault_wiring)
+    db_, nemesis_ = cmn.fsfault_wiring(db_, opts, data_dir)
+    if nemesis_ is None:
+        nemesis_ = cmn.pick_nemesis(db_, opts)
     test = noop_test()
     test.update(opts)
     test.update(
@@ -180,7 +191,7 @@ def consul_test(opts: dict) -> dict:
             "os": osdist.debian,
             "db": db_,
             "client": CASClient(),
-            "nemesis": cmn.pick_nemesis(db_, opts),
+            "nemesis": nemesis_,
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -204,7 +215,8 @@ def consul_test(opts: dict) -> dict:
 
 
 def _opt_spec(p) -> None:
-    cmn.nemesis_opt(p)
+    cmn.nemesis_opt(p, names=cmn.NEMESIS_NAMES
+                    + cmn.FSFAULT_NEMESIS_NAMES)
     p.add_argument("--archive-url", dest="archive_url", default=None,
                    help="consul release archive (or the in-repo sim "
                         "archive for hermetic runs).")

@@ -316,21 +316,8 @@ def etcd_test(opts: dict) -> dict:
     # dir: the DB wrapper owns the mount (it must precede the daemon),
     # the nemesis only flips the fault switch — etcd is statically
     # linked Go, so the LD_PRELOAD backend can't touch it
-    nemesis_name = opts.get("nemesis") or ""
-    if nemesis_name.startswith("fs-break"):
-        from ..nemesis import fsfault
-
-        # ONE opt_dir for both the mount owner and the switch flipper:
-        # they share the control file, and diverging dirs would make
-        # every break/clear a silent no-op
-        fs_opt = opts.get("fsfault_opt_dir", fsfault.OPT_DIR)
-        db_ = fsfault.FaultFsDB(db_, data_dir, opt_dir=fs_opt)
-        nemesis_ = fsfault.fs_fault_nemesis(
-            backend="fuse", manage_mounts=False, opt_dir=fs_opt,
-            default_mode=("break-one-percent"
-                          if nemesis_name == "fs-break-1pct"
-                          else "break-all"))
-    else:
+    db_, nemesis_ = cmn.fsfault_wiring(db_, opts, data_dir)
+    if nemesis_ is None:
         nemesis_ = cmn.pick_nemesis(db_, opts)
     test = noop_test()
     per_key = opts.get("ops_per_key", 300)
@@ -383,7 +370,7 @@ def etcd_test(opts: dict) -> dict:
 
 def _opt_spec(p) -> None:
     cmn.nemesis_opt(p, names=cmn.PARTITION_NEMESIS_NAMES
-                    + ("fs-break", "fs-break-1pct"))
+                    + cmn.FSFAULT_NEMESIS_NAMES)
 
 
 def main(argv=None) -> None:

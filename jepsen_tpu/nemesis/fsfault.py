@@ -300,11 +300,36 @@ class FaultFsDB(db_mod.DB, db_mod.LogFiles):
             return self.inner.log_files(test, node)
         return []
 
+    @staticmethod
+    def _split(inner):
+        """(install, start) when the inner DB's setup genuinely IS
+        install-then-start — i.e. the class that OWNS setup() in the
+        MRO also declares the split pieces. A subclass that overrides
+        setup() without re-declaring install (tidb's multi-role
+        bring-up, chronos' extra dirs) must NOT be bypassed: inherited
+        install/start from a base class describe the BASE's setup, not
+        the override's."""
+        cls = type(inner)
+        owner = next((k for k in cls.__mro__ if "setup" in vars(k)),
+                     None)
+        if owner is None:
+            return None, None
+        declares_split = ("install" in vars(owner)
+                          and ("start_and_await" in vars(owner)
+                               or "start" in vars(owner)))
+        if not declares_split:
+            return None, None
+        # "bring the daemon to ready": ArchiveDB calls it
+        # start_and_await; suites with a bare start (etcd) fold the
+        # readiness wait into it
+        return (getattr(inner, "install"),
+                getattr(inner, "start_and_await", None)
+                or getattr(inner, "start"))
+
     def setup(self, test, node) -> None:
         remote = test["remote"]
         install_fuse(remote, node, self.opt_dir)
-        inner_install = getattr(self.inner, "install", None)
-        inner_start = getattr(self.inner, "start", None)
+        inner_install, inner_start = self._split(self.inner)
         if inner_install and inner_start:
             # the right interposition point: after install's tree wipe,
             # before the daemon opens any file (a post-start mount

@@ -29,6 +29,67 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+def test_consul_suite_fs_break_wiring(tmp_path):
+    """The shared cmn.fsfault_wiring drives consul too (the agent's
+    -data-dir): full engine run with a mid-run storm over the
+    interposed data dir, via the generic ArchiveDB install/
+    start_and_await split."""
+    from jepsen_tpu.dbs import consul, consul_sim
+
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    consul_dir = os.path.join(remote.node_dir("n1"), "opt", "consul")
+    data = os.path.join(consul_dir, "data")
+    os.makedirs(data, exist_ok=True)
+    archive = str(tmp_path / "consul-sim.tar.gz")
+    # state inside the interposed -data-dir: storms bite the agent
+    consul_sim.build_archive(archive,
+                             os.path.join(data, "state.json"))
+    opt_dir = os.path.join(remote.node_dir("n1"), "opt", "jepsen")
+    opts = {
+        "nemesis": "fs-break",
+        "archive_url": f"file://{archive}",
+        "time_limit": 8,
+        "fsfault_opt_dir": opt_dir,
+    }
+    test = consul.consul_test(opts)
+    assert isinstance(test["db"], fsfault.FaultFsDB)
+    test.update({
+        "nodes": ["n1"],
+        "remote": remote,
+        "os": None,
+        "net": None,
+        "concurrency": 3,
+        "consul": {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {"n1": free_port()},
+            "dir": lambda n: consul_dir,
+            "sudo": None,
+        },
+    })
+    def client_phase():
+        return gen.time_limit(2, gen.clients(gen.limit(25, gen.stagger(
+            0.02, gen.mix([consul.r, consul.w, consul.cas])))))
+
+    test["generator"] = gen.phases(
+        client_phase(),
+        gen.nemesis(gen.once({"type": "info", "f": "start"})),
+        client_phase(),  # ops DURING the storm (ctl window is 100ms)
+        gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+        client_phase(),
+    )
+    result = core.run(test)
+    hist = result["history"]
+    assert result["results"]["valid"] in (True, "unknown")
+    assert not os.path.exists(fsfault.backing_dir(data))
+    nem_ops = [o for o in hist if o.process == "nemesis"]
+    assert any(o.f in ("break-all", "start") for o in nem_ops)
+    # the storm actually bit the agent: client ops errored while broken
+    errs = [o for o in hist
+            if o.process != "nemesis" and o.type in ("fail", "info")]
+    assert errs, "EIO storm produced no failed/indeterminate client ops"
+    assert [o for o in hist[-40:] if o.type == "ok"], "no ops after heal"
+
+
 def test_etcd_suite_fs_break_end_to_end(tmp_path):
     remote = LocalRemote(root=str(tmp_path / "nodes"))
     etcd_dir = os.path.join(remote.node_dir("n1"), "opt", "etcd")
