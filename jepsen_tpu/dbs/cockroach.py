@@ -74,6 +74,12 @@ def node_dir(test, node) -> str:
     return d(node) if callable(d) else d
 
 
+def data_dir(test, node) -> str:
+    """The node's --store dir; single source of truth — the faultfs
+    FUSE layer mounts over exactly this path."""
+    return f"{node_dir(test, node)}/data"
+
+
 # ---------------------------------------------------------------------------
 # DB (auto.clj:142-223)
 
@@ -88,6 +94,14 @@ class CockroachDB(db.DB, db.LogFiles):
         self.ready_timeout = ready_timeout
 
     def setup(self, test, node) -> None:
+        self.install(test, node)
+        self.start_and_await(test, node)
+
+    def install(self, test, node) -> None:
+        """Fetch + unpack only — split from start_and_await so the
+        faultfs FUSE layer can mount over the store dir between the
+        install's tree wipe and the daemon opening its first file
+        (fsfault.FaultFsDB)."""
         remote = test["remote"]
         d = node_dir(test, node)
         sudo = _cfg(test).get("sudo", True)
@@ -97,6 +111,8 @@ class CockroachDB(db.DB, db.LogFiles):
                 "cockroach tarball url required (binary distribution, or "
                 "the crdb_sim archive for hermetic runs)")
         cu.install_archive(remote, node, url, d, sudo=sudo)
+
+    def start_and_await(self, test, node) -> None:
         start_node(test, node)
         self.await_ready(test, node)
         # Ensure the jepsen database exists (auto.clj's csql! bootstrap)
@@ -161,7 +177,7 @@ def start_node(test, node) -> None:
         "--insecure",
         "--port", str(node_port(test, node)),
         *join_args,
-        "--store", f"{d}/data",
+        "--store", data_dir(test, node),
         logfile=f"{d}/cockroach.log",
         pidfile=f"{d}/cockroach.pid",
         chdir=d,
@@ -319,6 +335,22 @@ def startstop(n: int = 1) -> dict:
                 "cockroach",
                 targeter=lambda nodes: random.sample(list(nodes),
                                                      min(n, len(nodes)))),
+            "clocks": False}
+
+
+def fs_break(pct: int | None = None) -> dict:
+    """EIO storms on the --store dir via the faultfs FUSE layer —
+    needs the DB wrapped in FaultFsDB (basic_test wires that when
+    --nemesis/--nemesis2 name an fs-break mode); this entry is only
+    the switch flipper (charybdefs.clj:72-85 semantics)."""
+    from ..nemesis import fsfault
+
+    return {**nemesis_single_gen(),
+            "name": "fs-break" + ("-1pct" if pct == 1 else ""),
+            "client": fsfault.fs_fault_nemesis(
+                backend="fuse", manage_mounts=False,
+                default_mode=("break-one-percent" if pct == 1
+                              else "break-all")),
             "clocks": False}
 
 
@@ -637,6 +669,8 @@ def nemeses() -> dict:
         "huge-skews": huge_skews,
         "strobe-skews": strobe_skews,
         "split": splits,
+        "fs-break": fs_break,
+        "fs-break-1pct": lambda: fs_break(1),
     }
 
 
@@ -678,13 +712,25 @@ def basic_test(opts: dict, workload: dict) -> dict:
             gen.sleep(opts.get("quiesce", 30)),
             gen.clients(workload["final_client"]),
         ]
+    db_ = CockroachDB(tarball=opts.get("tarball"))
+    from .common import FSFAULT_NEMESIS_NAMES
+
+    if {opts.get("nemesis"), opts.get("nemesis2")} \
+            & set(FSFAULT_NEMESIS_NAMES):
+        # cockroach is a statically linked Go binary: FS faults need
+        # the FUSE backend, mounted between install and start. The
+        # switch flipper (the registry entry above) and this wrapper
+        # both resolve opt_dir from the test map's fsfault_opt_dir.
+        from ..nemesis import fsfault
+
+        db_ = fsfault.FaultFsDB(db_, data_dir)
     test = noop_test()
     test.update(opts)
     test.update(
         {
             "name": f"cockroachdb {workload['name']} {nem['name']}",
             "os": osdist.debian,
-            "db": CockroachDB(tarball=opts.get("tarball")),
+            "db": db_,
             "client": workload["client"],
             "nemesis": nem["client"],
             "generator": gen.phases(*phases),

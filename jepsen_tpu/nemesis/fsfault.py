@@ -290,10 +290,18 @@ class FaultFsDB(db_mod.DB, db_mod.LogFiles):
         db = fsfault.FaultFsDB(EtcdDB(...), data_dir_fn)
     """
 
-    def __init__(self, inner, data_dir_fn, opt_dir: str = OPT_DIR):
+    def __init__(self, inner, data_dir_fn,
+                 opt_dir: str | None = None):
         self.inner = inner
         self.data_dir_fn = data_dir_fn
         self.opt_dir = opt_dir
+
+    def _opt(self, test) -> str:
+        # explicit constructor arg wins; else the test map's
+        # fsfault_opt_dir (how registry-built wirings plumb it); else
+        # the default install dir
+        return (self.opt_dir or (test or {}).get("fsfault_opt_dir")
+                or OPT_DIR)
 
     def log_files(self, test, node) -> list:
         if isinstance(self.inner, db_mod.LogFiles):
@@ -314,21 +322,23 @@ class FaultFsDB(db_mod.DB, db_mod.LogFiles):
                      None)
         if owner is None:
             return None, None
-        declares_split = ("install" in vars(owner)
-                          and ("start_and_await" in vars(owner)
-                               or "start" in vars(owner)))
-        if not declares_split:
+        if "install" not in vars(owner):
             return None, None
-        # "bring the daemon to ready": ArchiveDB calls it
-        # start_and_await; suites with a bare start (etcd) fold the
-        # readiness wait into it
-        return (getattr(inner, "install"),
-                getattr(inner, "start_and_await", None)
-                or getattr(inner, "start"))
+        # "bring the daemon to ready": the piece must be the one the
+        # setup-OWNING class declares (ArchiveDB's start_and_await;
+        # etcd folds readiness into a bare start) — an inherited
+        # start_and_await describes the BASE's setup, not an override
+        # that deliberately composed install()+start() differently
+        if "start_and_await" in vars(owner):
+            return inner.install, inner.start_and_await
+        if "start" in vars(owner):
+            return inner.install, inner.start
+        return None, None
 
     def setup(self, test, node) -> None:
         remote = test["remote"]
-        install_fuse(remote, node, self.opt_dir)
+        opt_dir = self._opt(test)
+        install_fuse(remote, node, opt_dir)
         inner_install, inner_start = self._split(self.inner)
         if inner_install and inner_start:
             # the right interposition point: after install's tree wipe,
@@ -336,14 +346,14 @@ class FaultFsDB(db_mod.DB, db_mod.LogFiles):
             # would miss every fd the daemon already holds)
             inner_install(test, node)
             mount_fuse(remote, node, self.data_dir_fn(test, node),
-                       self.opt_dir)
+                       opt_dir)
             inner_start(test, node)
         else:
             # no install/start split: the data dir must live OUTSIDE
             # the inner DB's install tree, or its setup will collide
             # with the live mountpoint
             mount_fuse(remote, node, self.data_dir_fn(test, node),
-                       self.opt_dir)
+                       opt_dir)
             self.inner.setup(test, node)
 
     def teardown(self, test, node) -> None:
@@ -464,7 +474,7 @@ class FsFaultNemesis(Nemesis):
     DB up after the nemesis."""
 
     def __init__(self, prefix_fn=None, default_mode: str = "break-all",
-                 opt_dir: str = OPT_DIR, backend: str = "preload",
+                 opt_dir: str | None = None, backend: str = "preload",
                  data_dir_fn=None, manage_mounts: bool = True):
         assert backend in ("preload", "fuse"), backend
         if backend == "fuse" and manage_mounts and data_dir_fn is None:
@@ -476,20 +486,25 @@ class FsFaultNemesis(Nemesis):
         self.data_dir_fn = data_dir_fn
         self.manage_mounts = manage_mounts
 
+    def _opt(self, test) -> str:
+        return (self.opt_dir or (test or {}).get("fsfault_opt_dir")
+                or OPT_DIR)
+
     def setup(self, test):
         remote = test["remote"]
+        opt_dir = self._opt(test)
         if self.backend == "fuse":
             if self.manage_mounts:
                 def up(n):
-                    install_fuse(remote, n, self.opt_dir)
+                    install_fuse(remote, n, opt_dir)
                     mount_fuse(remote, n, self.data_dir_fn(test, n),
-                               self.opt_dir)
+                               opt_dir)
                 real_pmap(up, test["nodes"])
             else:  # FaultFsDB owns the mounts; start healed
-                real_pmap(lambda n: clear(remote, n, self.opt_dir),
+                real_pmap(lambda n: clear(remote, n, opt_dir),
                           test["nodes"])
         else:
-            real_pmap(lambda n: install(remote, n, self.opt_dir),
+            real_pmap(lambda n: install(remote, n, opt_dir),
                       test["nodes"])
         return self
 
@@ -501,17 +516,19 @@ class FsFaultNemesis(Nemesis):
         if f == "stop":
             f = "clear"
 
+        opt_dir = self._opt(test)
+
         def apply(node):
             prefix = self.prefix_fn(test, node)
             if f == "break-all":
-                break_all(remote, node, prefix, self.opt_dir)
+                break_all(remote, node, prefix, opt_dir)
             elif f == "break-one-percent":
-                break_percent(remote, node, 1, prefix, self.opt_dir)
+                break_percent(remote, node, 1, prefix, opt_dir)
             elif f == "break-percent":
                 break_percent(remote, node, int(op.value), prefix,
-                              self.opt_dir)
+                              opt_dir)
             elif f == "clear":
-                clear(remote, node, self.opt_dir)
+                clear(remote, node, opt_dir)
             else:
                 raise ValueError(f"fsfault can't handle {op.f!r}")
             return f
@@ -522,9 +539,10 @@ class FsFaultNemesis(Nemesis):
 
     def teardown(self, test):
         remote = test["remote"]
+        opt_dir = self._opt(test)
         for node in test["nodes"]:
             try:
-                clear(remote, node, self.opt_dir)
+                clear(remote, node, opt_dir)
             except RemoteError:
                 log.warning("fsfault clear failed on %s", node,
                             exc_info=True)
@@ -542,7 +560,7 @@ def fs_fault_nemesis(prefix_fn=None,
                      backend: str = "preload",
                      data_dir_fn=None,
                      manage_mounts: bool = True,
-                     opt_dir: str = OPT_DIR) -> FsFaultNemesis:
+                     opt_dir: str | None = None) -> FsFaultNemesis:
     return FsFaultNemesis(prefix_fn, default_mode, opt_dir=opt_dir,
                           backend=backend, data_dir_fn=data_dir_fn,
                           manage_mounts=manage_mounts)

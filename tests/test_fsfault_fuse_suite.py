@@ -90,6 +90,58 @@ def test_consul_suite_fs_break_wiring(tmp_path):
     assert [o for o in hist[-40:] if o.type == "ok"], "no ops after heal"
 
 
+def test_cockroach_suite_fs_break_registry(tmp_path):
+    """Cockroach's named-nemesis REGISTRY path: --nemesis fs-break
+    resolves the switch-flipper entry, basic_test wraps the DB in
+    FaultFsDB, and both sides pick up fsfault_opt_dir from the test
+    map. The sim's state file lives inside the interposed --store dir,
+    so the registry's 5s-delay/5s-duration storm cycle bites real
+    client ops."""
+    from jepsen_tpu.dbs import cockroach as cr
+    from jepsen_tpu.dbs import cockroach_workloads as crw
+    from jepsen_tpu.dbs import crdb_sim
+
+    remote = LocalRemote(root=str(tmp_path / "nodes"))
+    crdb_dir = os.path.join(remote.node_dir("n1"), "opt", "crdb")
+    data = os.path.join(crdb_dir, "data")
+    os.makedirs(data, exist_ok=True)
+    archive = str(tmp_path / "crdb-sim.tar.gz")
+    crdb_sim.build_archive(archive, os.path.join(data, "crdb.json"))
+    opt_dir = os.path.join(remote.node_dir("n1"), "opt", "jepsen")
+    opts = {
+        "workload": "register",
+        "nodes": ["n1"],
+        "remote": remote,
+        "cockroach": {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {"n1": free_port()},
+            "dir": lambda n: crdb_dir,
+            "sudo": None,
+        },
+        "tarball": f"file://{archive}",
+        "concurrency": 4,
+        "time_limit": 8,
+        "quiesce": 0.2,
+        "nemesis": "fs-break",
+        "fsfault_opt_dir": opt_dir,
+        "ops_per_key": 20,
+        "threads_per_key": 2,
+    }
+    t = crw.cockroach_test(opts)
+    t["os"] = None
+    t["net"] = None
+    assert isinstance(t["db"], fsfault.FaultFsDB)
+    result = core.run(t)
+    hist = result["history"]
+    assert result["results"]["valid"] in (True, "unknown")
+    assert not os.path.exists(fsfault.backing_dir(data))
+    import subprocess
+    assert subprocess.run(["mountpoint", "-q", data]).returncode != 0
+    nem_starts = [o for o in hist
+                  if o.process == "nemesis" and o.f == "start"]
+    assert nem_starts, "registry storm cycle never fired"
+
+
 def test_etcd_suite_fs_break_end_to_end(tmp_path):
     remote = LocalRemote(root=str(tmp_path / "nodes"))
     etcd_dir = os.path.join(remote.node_dir("n1"), "opt", "etcd")

@@ -231,15 +231,32 @@ class TestPallasVecParity:
             by_model.setdefault(case["model"], []).append((case, es))
 
         assert by_model, "no pallas-eligible corpus cases?"
-        checked = 0
+        checked = n_invalid = 0
         for model_name, pairs in by_model.items():
             model = MODELS[model_name]()
             results = wgl_pallas_vec.analysis_batch(
                 model, [es for _, es in pairs])
-            for (case, _), r in zip(pairs, results):
+            for (case, es), r in zip(pairs, results):
                 assert r.valid == case["expected"], (
                     f"pallas-vec mismatch on {case['name']}: "
                     f"{r.valid} != {case['expected']}"
                 )
+                if r.valid is False:
+                    # in-kernel counterexamples must match the host
+                    # oracle EXACTLY: first visits happen in the same
+                    # DFS order and the bounded cache only ever prunes
+                    # a subset of the unbounded memo, so best prefix
+                    # and stuck op are deterministic across engines
+                    hr = wgl_host.analysis(model, es)
+                    assert (r.op is None) == (hr.op is None), case["name"]
+                    if r.op is not None:
+                        assert r.op.index == hr.op.index, case["name"]
+                    assert ([o.index for o in
+                             (r.best_linearization or [])]
+                            == [o.index for o in
+                                (hr.best_linearization or [])]), \
+                        case["name"]
+                    n_invalid += 1
                 checked += 1
         assert checked >= 90
+        assert n_invalid >= 10  # the counterexample path was exercised
