@@ -269,9 +269,6 @@ def main():
                 hist.append(Op(p, "fail", "transfer", v, time=t, index=t))
         t += 1
     test_map = {"accounts": accounts, "total_amount": 80, "max_transfer": 5}
-    t0 = time.monotonic()
-    bank_res = bank_wl.checker().check(test_map, hist, {})
-    assert bank_res["valid"] is True, bank_res
 
     sf_hist = []
     present = []
@@ -289,14 +286,30 @@ def main():
             sf_hist.append(Op(p, "ok", "read", list(present), time=t,
                               index=t))
             t += 1
-    sf_res = checker_mod.set_full().check({}, sf_hist, {})
-    assert sf_res["valid"] is True, {k: sf_res[k] for k in ("valid",)}
-    wall = time.monotonic() - t0
+    # median of 3: this host-side lane's wall is tens of ms, where OS
+    # noise alone is ~25% — the same honesty rule as the TPU lanes
+    # (identical inputs are fine here: no tunnel launch memoizer)
+    n_ops = len(hist) + len(sf_hist)
+    walls = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        bank_res = bank_wl.checker().check(test_map, hist, {})
+        sf_res = checker_mod.set_full().check({}, sf_hist, {})
+        walls.append(time.monotonic() - t0)
+        assert bank_res["valid"] is True, bank_res
+        assert sf_res["valid"] is True, {k: sf_res[k] for k in ("valid",)}
+    walls.sort()
+    wall = walls[1]
     configs["bank-setfull"] = {
-        "ops": len(hist) + len(sf_hist),
+        "ops": n_ops,
         "wall_s": round(wall, 3),
-        "ops_per_s": round((len(hist) + len(sf_hist)) / wall, 1),
+        "ops_per_s": round(n_ops / wall, 1),
         "verdicts": {"true": 2, "false": 0, "unknown": 0},
+        "spread": {
+            "k": 3,
+            "ops_per_s_min": round(n_ops / walls[-1], 1),
+            "ops_per_s_max": round(n_ops / walls[0], 1),
+        },
     }
 
     # ------------------------------------------------------------------
@@ -408,8 +421,12 @@ def main():
     # sequentially, (b) the XLA while-loop kernel, (c) the pallas
     # lane-vectorized kernel. Valid lanes at 34/256/1024 (shallow
     # searches: the reference's ~128-op per-key shape) plus a 4096-lane
-    # refutation-heavy batch (deep searches — where the fixed TPU
-    # launch cost amortizes and the TPU wins outright).
+    # refutation-heavy batch. After the r4 transfer overhaul the
+    # pallas end-to-end gap at deep-4096 is ~1.1-1.3x (spreads
+    # overlap; best pallas reps beat best native reps) with the
+    # kernel-resident decomposition showing the remaining loss is
+    # entirely the tunnel's ~4-11MB/s + ~110ms round trips, not the
+    # search itself.
     from jepsen_tpu.ops import wgl_pallas_vec
 
     def pallas_kernel_resident_ms(n_keys, ops_per_key, corrupt,
@@ -437,11 +454,14 @@ def main():
         wlanes, _ = build_cas_lanes(n_keys, ops_per_key, 5,
                                     seed=seed + 1, corrupt=corrupt)
         wpacked, _ = wgl_pallas_vec._pack(wlanes, jm, n_pad)
-        _np.asarray(run(jax.device_put(wpacked), msteps))  # compile+warm
+        ws, wb = run(jax.device_put(wpacked), msteps)  # compile+warm
+        _np.asarray(ws), _np.asarray(wb)
         del wpacked
         t0 = time.monotonic()
-        _np.asarray(run(dev, msteps))  # fetch inside the window: the
-        # only reliable completion sync through the tunnel
+        sm, _best = run(dev, msteps)
+        _np.asarray(sm)  # fetch inside the window: the only reliable
+        # completion sync through the tunnel (the small verdict block —
+        # what the production path fetches eagerly)
         return round((time.monotonic() - t0) * 1e3, 1)
 
     def backend_walls(n_keys, ops_per_key, corrupt, max_steps, seed,

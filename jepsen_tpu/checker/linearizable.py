@@ -71,17 +71,20 @@ TRUNCATE = 10
 # (~8-10M steps/s, no launch latency — a typical valid per-key lane
 # resolves in well under a millisecond) and then finishes the
 # unresolved tail with the full budget. The pallas lane kernel beats
-# native kernel-resident, but on this tunnel-attached host the fixed
-# dispatch+fetch round trip (~110ms) plus ~25-50MB/s transfer set an
-# end-to-end floor native undercuts at shallow shapes, and on DEEP
-# refutation searches the kernel's bounded VMEM cache re-explores
-# ~20x the steps native's unbounded memo prunes — so with a working
-# C++ toolchain native wins end-to-end at every measured shape (r4:
-# the gap closed from ~2.4x to ~1.1-1.3x after single-buffer
-# transfers, memoized encoding, and in-kernel counterexamples, but
-# did not invert). Auto escalates to pallas only when native is
-# UNAVAILABLE (e.g. a TPU VM without a compiler), where it beats the
-# pure-Python host search by >10x on batches.
+# native kernel-resident (~80M steps/s across 128 lanes vs ~10M
+# single-thread), but on this tunnel-attached host the fixed
+# dispatch+fetch round trip (~110ms) and the tunnel's ~4-11MB/s
+# transfer rate set an end-to-end floor native undercuts — even after
+# r4 cut the transfer to per-entry facts only (node maps and the
+# linked list are derived in-kernel, values 16-bit-packed, the
+# counterexample stack fetched lazily as int16), the deep-4096 gap
+# only closed from ~2.4x to ~1.2x and did not invert; shallow shapes
+# are round-trip-bound outright. So with a working C++ toolchain
+# native wins end-to-end at every measured shape ON THIS HOST; on
+# PCIe-attached TPU hardware the same decomposition favors the
+# kernel. Auto escalates to pallas only when native is UNAVAILABLE
+# (e.g. a TPU VM without a compiler), where it beats the pure-Python
+# host search by >10x on batches.
 TRIAGE_MAX_STEPS = 2_000
 
 

@@ -178,6 +178,76 @@ class TestHostVerdictParity:
         r = one(CASRegister(), h(invoke_op(0, "read"), ok_op(0, "read")))
         assert r.valid is True
 
+    def test_wide_values_v32_fallback(self):
+        """Payloads outside int16 disable the 16-bit value packing:
+        _pack falls back to separate int32 value rows (3n+1 vs 2n+1)
+        and the launcher's unpack must follow the row count."""
+        m = CASRegister()
+        big = 2 ** 20
+        good = h(
+            invoke_op(0, "write", big), ok_op(0, "write", big),
+            invoke_op(1, "read"), ok_op(1, "read", big),
+            invoke_op(0, "cas", (big, -big)), ok_op(0, "cas", (big, -big)),
+            invoke_op(1, "read"), ok_op(1, "read", -big),
+        )
+        es = make_entries(good)
+        buf, _ = wgl_pallas_vec._pack(
+            [es], wgl_pallas_vec.mjit.for_model(m),
+            wgl_pallas_vec._pad_size(len(es)))
+        assert buf.shape[0] == 3 * wgl_pallas_vec._pad_size(len(es)) + 1
+        assert one(m, good).valid is True
+        bad = h(
+            invoke_op(0, "write", big), ok_op(0, "write", big),
+            invoke_op(1, "read"), ok_op(1, "read", big + 1),
+        )
+        assert one(m, bad).valid is False
+
+    def test_v16_pinnable_for_survivor_pass(self):
+        """The two-pass scheduler relaunches a SUBSET of the batch and
+        pins _pack to the pass-1 layout — a flipped row count would
+        retrace the launcher jit (~1s Mosaic compile) mid-check."""
+        m = CASRegister()
+        jm = wgl_pallas_vec.mjit.for_model(m)
+        wide = make_entries(h(
+            invoke_op(0, "write", 2 ** 20), ok_op(0, "write", 2 ** 20)))
+        narrow = make_entries(h(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1)))
+        n_pad = wgl_pallas_vec._pad_size(2)
+        buf, _ = wgl_pallas_vec._pack([wide, narrow], jm, n_pad)
+        assert buf.shape[0] == 3 * n_pad + 1  # mixed batch: v32
+        # survivor subset is all-narrow, but the pin holds the layout
+        buf2, _ = wgl_pallas_vec._pack([narrow], jm, n_pad, v16=False)
+        assert buf2.shape[0] == 3 * n_pad + 1
+
+    def test_boundary_values_stay_v16(self):
+        """-32768 and 32766 still fit the 16-bit packing (32767 is the
+        NIL sentinel); verdicts must survive the sign-extension."""
+        m = CASRegister()
+        hist = h(
+            invoke_op(0, "write", -32768), ok_op(0, "write", -32768),
+            invoke_op(1, "read"), ok_op(1, "read", -32768),
+            invoke_op(0, "write", 32766), ok_op(0, "write", 32766),
+            invoke_op(1, "read"), ok_op(1, "read", 32766),
+        )
+        es = make_entries(hist)
+        buf, _ = wgl_pallas_vec._pack(
+            [es], wgl_pallas_vec.mjit.for_model(m),
+            wgl_pallas_vec._pad_size(len(es)))
+        assert buf.shape[0] == 2 * wgl_pallas_vec._pad_size(len(es)) + 1
+        assert one(m, hist).valid is True
+        # the sentinel value itself must NOT be 16-bit-packed: 32767
+        # as a real payload would alias NIL
+        h2 = h(
+            invoke_op(0, "write", 32767), ok_op(0, "write", 32767),
+            invoke_op(1, "read"), ok_op(1, "read", 32767),
+        )
+        es2 = make_entries(h2)
+        buf2, _ = wgl_pallas_vec._pack(
+            [es2], wgl_pallas_vec.mjit.for_model(m),
+            wgl_pallas_vec._pad_size(len(es2)))
+        assert buf2.shape[0] == 3 * wgl_pallas_vec._pad_size(len(es2)) + 1
+        assert one(m, h2).valid is True
+
 
 class TestInKernelCounterexample:
     """INVALID lanes carry their counterexample out of the kernel
