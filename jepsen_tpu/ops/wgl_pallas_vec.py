@@ -23,10 +23,12 @@ vector unit:
   slot from a carried Zobrist fold, computed inline — no table).
   Pruning differs from the host's unbounded memo — step counts may
   differ, and DEEP refutation searches re-explore what native's
-  unbounded memo prunes (measured ~20x the steps on exhaustive
-  deep batches; bounded VMEM cannot replicate an unbounded memo) —
-  but any exact-compare cache is sound, so VERDICTS are bit-identical
-  to the host search (asserted by the parity tests).
+  unbounded memo prunes (~6-7x the steps on exhaustive 256-op deep
+  batches; bounded VMEM cannot replicate an unbounded memo, and the
+  O(slots) lookup makes bigger caches a net loss — see the insert
+  comment for the measured sweep) — but any exact-compare cache is
+  sound, so VERDICTS are bit-identical to the host search (asserted
+  by the parity tests).
 - INVALID lanes carry their counterexample out of the kernel (deepest
   prefix + stuck entry, wgl_search.cpp:329-341 semantics): the host
   formats it instead of re-searching.
@@ -119,7 +121,8 @@ def _state_pad(jm, entries_list) -> int:
     return max(8, _next_pow2(w))
 
 
-def _make_kernel(jm, n_pad: int, n_state: int):
+def _make_kernel(jm, n_pad: int, n_state: int,
+                 cache_slots: int = CACHE_SLOTS):
     from jax.experimental import pallas as pl  # noqa: F401
 
     m_pad = _m_pad(n_pad)
@@ -132,7 +135,7 @@ def _make_kernel(jm, n_pad: int, n_state: int):
     # queue memo keys are the bitset alone (state is a function of
     # WHICH ops linearized); scalar keys append the one state word
     key_words = nw if uq else nw + 1
-    cache_mask_c = CACHE_SLOTS - 1
+    cache_mask_c = cache_slots - 1
 
     def kernel(f_ref, v1_ref, v2_ref, crashed_ref, call_ref, ret_ref,
                nn_ref, ncomp_ref, msteps_ref,
@@ -143,7 +146,7 @@ def _make_kernel(jm, n_pad: int, n_state: int):
         m_iota = jax.lax.broadcasted_iota(i32, (m_pad, LANES), 0)
         n_iota = jax.lax.broadcasted_iota(i32, (n_pad, LANES), 0)
         w_iota = jax.lax.broadcasted_iota(i32, (nw_pad, LANES), 0)
-        c_iota = jax.lax.broadcasted_iota(i32, (CACHE_SLOTS, LANES), 0)
+        c_iota = jax.lax.broadcasted_iota(i32, (cache_slots, LANES), 0)
 
         # --- per-program init (scratch persists across programs; a
         # stale cache entry from another block would wrongly match).
@@ -156,8 +159,8 @@ def _make_kernel(jm, n_pad: int, n_state: int):
         nxt[...] = jnp.where(m_iota < two_n, m_iota + 1, 0)
         prv[...] = jnp.where((m_iota >= 1) & (m_iota <= two_n),
                              m_iota - 1, 0)
-        cache[...] = jnp.zeros((CACHE_SLOTS, key_words * LANES), i32)
-        cache_used[...] = jnp.zeros((CACHE_SLOTS, LANES), i32)
+        cache[...] = jnp.zeros((cache_slots, key_words * LANES), i32)
+        cache_used[...] = jnp.zeros((cache_slots, LANES), i32)
         beststack_ref[...] = jnp.zeros((n_pad, LANES), i32)
 
         n_completed = ncomp_ref[...]                     # [1, L]
@@ -379,7 +382,18 @@ def _make_kernel(jm, n_pad: int, n_state: int):
                 m_iota == posB_p, valB_p,
                 jnp.where(m_iota == posA_p, valA_p, prv[...]))
 
-            # ---- cache insert (zobrist-hashed slot) + stack push ----
+            # ---- cache insert (zobrist-hashed slot) + stack push.
+            # Always-overwrite is the MEASURED best retention at this
+            # design point (512 deep 256-op lanes, 200k cap):
+            # depth-preferential retention (protect shallow entries —
+            # they guard bigger subtrees) LOST ~6% steps because
+            # abandoned branches' shallow entries squat in slots, and
+            # growing capacity loses outright: the no-dynamic-indexing
+            # lookup is O(slots), so C=1024 cut steps 17.8M -> 6.9M
+            # but wall ROSE 593ms -> 1521ms. The bounded-vs-unbounded
+            # memo gap vs native (~6-7x steps on exhaustive deep
+            # batches) is structural to lane-vectorized VMEM search,
+            # not a tuning miss. ----
             sl = (c_iota == slot) & do_lift              # [C, L]
             for w in range(nw):
                 cache[:, w * LANES:(w + 1) * LANES] = jnp.where(
@@ -564,21 +578,22 @@ _kernel_cache: dict = {}
 
 
 def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
-              n_state: int = 1):
-    """One jitted pallas_call per (model, shape, blocks) — building the
-    call is ~1 s of host tracing, dwarfing the sub-ms kernel, so it
-    must happen once, not per invocation. The step budget is a runtime
-    input, so every cap shares one compiled kernel."""
+              n_state: int = 1, cache_slots: int = CACHE_SLOTS):
+    """One jitted pallas_call per (model, shape, blocks, cache) —
+    building the call is ~1 s of host tracing, dwarfing the sub-ms
+    kernel, so it must happen once, not per invocation. The step
+    budget is a runtime input, so every cap shares one compiled
+    kernel."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    key = (jm.name, n_pad, interpret, n_blocks, n_state)
+    key = (jm.name, n_pad, interpret, n_blocks, n_state, cache_slots)
     if key in _kernel_cache:
         return _kernel_cache[key]
 
     uq = not isinstance(jm, mjit.JitModel)
     key_words = _nw(n_pad) if uq else _nw(n_pad) + 1
-    kernel, m_pad = _make_kernel(jm, n_pad, n_state)
+    kernel, m_pad = _make_kernel(jm, n_pad, n_state, cache_slots)
     nw = _nw(n_pad)
 
     def spec(rows):
@@ -608,8 +623,8 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
             # stack_s is untouched for the queue (inverse-step
             # backtracking); keep a token row so the arity is fixed
             pltpu.VMEM((8 if uq else n_pad, LANES), jnp.int32),
-            pltpu.VMEM((CACHE_SLOTS, key_words * LANES), jnp.int32),
-            pltpu.VMEM((CACHE_SLOTS, LANES), jnp.int32),
+            pltpu.VMEM((cache_slots, key_words * LANES), jnp.int32),
+            pltpu.VMEM((cache_slots, LANES), jnp.int32),
         ],
         interpret=interpret,
     )
