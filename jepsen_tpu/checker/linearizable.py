@@ -40,6 +40,7 @@ set "can take *hours*" to write, checker.clj:138-141).
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 from typing import Any
 
@@ -254,7 +255,12 @@ class Linearizable(Checker):
         # only where no native toolchain exists (policy rationale at
         # TRIAGE_MAX_STEPS above). Native availability is PER LANE —
         # a single lane with (say) a payload outside int32 must not
-        # derail the rest of the batch ----
+        # derail the rest of the batch. The C++ engine is stateless
+        # per call and ctypes drops the GIL for its duration, so on
+        # multi-core control nodes lanes fan out over a thread pool
+        # (the reference's bounded-pmap per-key checking,
+        # independent.clj:269-287); results are finished on this
+        # thread — finish() renders SVGs and is not re-entrant. ----
         try:
             from ..ops import wgl_native
 
@@ -263,25 +269,33 @@ class Linearizable(Checker):
         except Exception:  # noqa: BLE001 — no toolchain / build failure
             native_ok = [False] * n
 
-        pending = []
-        for i in range(n):
-            if not native_ok[i]:
-                pending.append(i)
-                continue
-            r = wgl_native.analysis(model, ess[i],
-                                    max_steps=TRIAGE_MAX_STEPS)
+        def native_map(idxs, fn):
+            """[(i, WGLResult)] for idxs, pooled when it can help."""
+            workers = min(len(idxs), os.cpu_count() or 1, 16)
+            if workers > 1 and len(idxs) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    return list(zip(idxs, pool.map(fn, idxs)))
+            return [(i, fn(i)) for i in idxs]
+
+        triage = [i for i in range(n) if native_ok[i]]
+        pending = [i for i in range(n) if not native_ok[i]]
+        for i, r in native_map(
+                triage,
+                lambda i: wgl_native.analysis(
+                    model, ess[i], max_steps=TRIAGE_MAX_STEPS)):
             if r.valid == "unknown":
                 pending.append(i)
             else:
                 finish(i, r)
 
-        rest = []
-        for i in pending:
-            if native_ok[i]:
-                finish(i, wgl_native.analysis(
-                    model, ess[i], time_limit=self.time_limit))
-            else:
-                rest.append(i)
+        rest = [i for i in pending if not native_ok[i]]
+        for i, r in native_map(
+                [i for i in pending if native_ok[i]],
+                lambda i: wgl_native.analysis(
+                    model, ess[i], time_limit=self.time_limit)):
+            finish(i, r)
         if rest:
             sub = [ess[i] for i in rest]
             if _pallas_eligible(model, sub):

@@ -216,6 +216,33 @@ class TestBatchedChecking:
             rs = chk.check_batch({}, [(iter(bad), {})])
             assert rs[0]["valid"] is False, algo
 
+    def test_check_batch_pooled_native_triage(self, monkeypatch):
+        """On multi-core hosts the native triage/finish fan out over a
+        thread pool (the C++ engine is stateless and GIL-free). This
+        CI box has one core, so force the pool and pin verdict parity
+        with the sequential path — including counterexamples."""
+        import os as _os
+
+        from jepsen_tpu.history import index as _index
+
+        monkeypatch.setattr(_os, "cpu_count", lambda: 4)
+        hists = []
+        for k in range(12):
+            bad = k % 3 == 0
+            hists.append(_index([
+                invoke_op(0, "write", k), ok_op(0, "write", k),
+                invoke_op(1, "read", None),
+                ok_op(1, "read", 999 if bad else k),
+            ]))
+        chk = linearizable(CASRegister())
+        rs = chk.check_batch({}, [(h, {}) for h in hists])
+        for k, r in enumerate(rs):
+            if k % 3 == 0:
+                assert r["valid"] is False, k
+                assert r["op"] is not None
+            else:
+                assert r["valid"] is True, k
+
     def test_check_batch_mixed_native_eligibility(self):
         """One lane with a payload outside int32 must degrade THAT
         lane, not crash or derail the rest of the batch."""
