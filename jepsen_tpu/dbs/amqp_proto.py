@@ -36,6 +36,7 @@ BASIC_GET = (60, 70)
 BASIC_GET_OK = (60, 71)
 BASIC_GET_EMPTY = (60, 72)
 BASIC_ACK = (60, 80)
+BASIC_REJECT = (60, 90)
 CONFIRM_SELECT = (85, 10)
 CONFIRM_SELECT_OK = (85, 11)
 
@@ -177,10 +178,10 @@ class AmqpConn:
         tag, = struct.unpack_from(">Q", payload)
         return tag >= self._publish_seq or bool(payload[8] & 1)
 
-    def get(self, queue: str):
-        """Auto-ack basic.get: body bytes, or None when empty
-        (langohr's lb/get, rabbitmq.clj:110)."""
-        args = struct.pack(">H", 0) + shortstr(queue) + b"\x01"  # no-ack
+    def _basic_get(self, queue: str, no_ack: bool):
+        """basic.get: (delivery_tag, body), or None when empty."""
+        args = (struct.pack(">H", 0) + shortstr(queue)
+                + (b"\x01" if no_ack else b"\x00"))
         self._send_method(1, BASIC_GET, args)
         ftype, _ch, payload = self._read_frame()
         cm = struct.unpack_from(">HH", payload)
@@ -188,6 +189,7 @@ class AmqpConn:
             return None
         if cm != BASIC_GET_OK:
             raise AmqpError(f"unexpected get reply {cm}")
+        tag, _redelivered = struct.unpack_from(">QB", payload, 4)
         ftype, _ch, header = self._read_frame()
         if ftype != FRAME_HEADER:
             raise AmqpError("expected content header")
@@ -198,7 +200,30 @@ class AmqpConn:
             if ftype != FRAME_BODY:
                 raise AmqpError("expected body frame")
             body += chunk
-        return body
+        return tag, body
+
+    def get(self, queue: str):
+        """Auto-ack basic.get: body bytes, or None when empty
+        (langohr's lb/get, rabbitmq.clj:110)."""
+        r = self._basic_get(queue, no_ack=True)
+        return None if r is None else r[1]
+
+    def get_unacked(self, queue: str):
+        """basic.get WITHOUT auto-ack: (delivery_tag, body), or None
+        when empty. The broker holds the message against this
+        connection until it is acked/rejected — or the connection
+        dies, at which point it requeues. This is the primitive under
+        the distributed-semaphore pattern (rabbitmq.clj:185-226:
+        holding the unacked delivery IS holding the mutex)."""
+        return self._basic_get(queue, no_ack=False)
+
+    def reject(self, delivery_tag: int, requeue: bool = True) -> None:
+        """basic.reject (no -ok reply in AMQP 0-9-1): releases an
+        unacked delivery, requeueing it when asked (lb/reject,
+        rabbitmq.clj:250)."""
+        args = struct.pack(">Q", delivery_tag) + bytes([1 if requeue
+                                                        else 0])
+        self._send_method(1, BASIC_REJECT, args)
 
     def close(self) -> None:
         try:

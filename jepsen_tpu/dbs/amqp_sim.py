@@ -1,8 +1,22 @@
 """A hermetic RabbitMQ lookalike: the AMQP 0-9-1 server subset
 amqp_proto speaks — PLAIN handshake, channel open, queue
 declare/purge, publisher confirms, basic.publish (method + header +
-body frames), basic.get with auto-ack. Queues are FIFO lists of
-base64 bodies in the shared flock store."""
+body frames), basic.get with and without auto-ack, basic.reject.
+Queues are FIFO lists of base64 bodies in the shared flock store.
+
+Unacked deliveries are PERSISTED in the shared store under a
+per-connection owner token (data["unacked"]); a reject-with-requeue
+or the connection dying puts them back at the HEAD of the shared
+queue — the broker behavior that makes the distributed-semaphore
+pattern (hold the unacked message = hold the mutex,
+rabbitmq.clj:185-263) unsafe under partitions: the broker requeues a
+"held" semaphore the moment it declares the holder's connection dead.
+Owner tokens are prefixed with this node's port, and serve() requeues
+any leftovers under its own prefix at startup — a killed broker
+process recovers its connections' unacked persistent messages on
+restart exactly like a durable RabbitMQ node, so a kill nemesis
+cannot silently lose the semaphore and leave the workload checking a
+trivially-valid all-fail history."""
 
 from __future__ import annotations
 
@@ -16,6 +30,60 @@ import time
 
 from . import amqp_proto as aq
 from .simbase import Store, build_sim_archive
+
+
+def _release_unacked(store: Store, token: str, entries: list,
+                     requeue: bool) -> None:
+    """Drop `entries` ([queue, body_b64] pairs) from `token`'s
+    persisted unacked set, prepending each to its queue if requeueing
+    — one transaction for atomicity with concurrent getters."""
+
+    def rel(data):
+        new = dict(data)
+        un = {k: list(v) for k, v in
+              (data.get("unacked") or {}).items()}
+        mine = list(un.get(token) or [])
+        queues = dict(data.get("queues") or {})
+        for queue, body in entries:
+            if [queue, body] in mine:
+                mine.remove([queue, body])
+                if requeue:
+                    queues[queue] = ([body]
+                                     + list(queues.get(queue) or []))
+        if mine:
+            un[token] = mine
+        else:
+            un.pop(token, None)
+        new["unacked"] = un
+        new["queues"] = queues
+        return None, new
+
+    store.transact(rel)
+
+
+def _recover_unacked(store: Store, port: int) -> int:
+    """Requeue every unacked delivery owned by a connection of THIS
+    node (token prefix "<port>:") — run at broker startup, when any
+    such connection is necessarily dead. This is durable-RabbitMQ
+    crash recovery: persistent messages that were delivered but never
+    acked come back on restart."""
+    prefix = f"{port}:"
+
+    def rec(data):
+        un = {k: list(v) for k, v in
+              (data.get("unacked") or {}).items()}
+        queues = dict(data.get("queues") or {})
+        n = 0
+        for token in [t for t in un if t.startswith(prefix)]:
+            for queue, body in un.pop(token):
+                queues[queue] = [body] + list(queues.get(queue) or [])
+                n += 1
+        new = dict(data)
+        new["unacked"] = un
+        new["queues"] = queues
+        return n, new
+
+    return store.transact(rec)
 
 
 class Handler(socketserver.BaseRequestHandler):
@@ -50,9 +118,18 @@ class Handler(socketserver.BaseRequestHandler):
                          struct.pack(">HH", *cm) + args)
 
     def handle(self):
+        import uuid
+
         self.request.settimeout(120.0)
         confirms = False
         publish_seq = 0
+        # This connection's owner token in the store's "unacked" area
+        # (port-prefixed so a restarted node can find its orphans),
+        # plus the in-memory delivery-tag -> store-entry map.
+        self.token = (f"{self.server.server_address[1]}:"
+                      f"{uuid.uuid4().hex[:12]}")
+        self.unacked = {}
+        self.next_tag = 1
         try:
             if self._read_exact(8) != b"AMQP\x00\x00\x09\x01":
                 return
@@ -139,7 +216,8 @@ class Handler(socketserver.BaseRequestHandler):
                             channel, aq.BASIC_ACK,
                             struct.pack(">QB", publish_seq, 0))
                 elif cm == aq.BASIC_GET:
-                    queue, _ = aq.read_shortstr(args, 2)
+                    queue, pos = aq.read_shortstr(args, 2)
+                    no_ack = bool(args[pos]) if pos < len(args) else True
 
                     def take(data):
                         queues = dict(data.get("queues") or {})
@@ -150,6 +228,15 @@ class Handler(socketserver.BaseRequestHandler):
                         queues[queue] = rest
                         new = dict(data)
                         new["queues"] = queues
+                        if not no_ack:
+                            # the delivery stays PERSISTED under this
+                            # connection's owner token until acked,
+                            # rejected, or recovered (module docstring)
+                            un = {k: list(v) for k, v in
+                                  (data.get("unacked") or {}).items()}
+                            un[self.token] = (un.get(self.token) or
+                                              []) + [[queue, head]]
+                            new["unacked"] = un
                         return head, new
 
                     got = self.store.transact(take)
@@ -157,21 +244,46 @@ class Handler(socketserver.BaseRequestHandler):
                         self._send_method(channel, aq.BASIC_GET_EMPTY,
                                           aq.shortstr(""))
                     else:
+                        tag = self.next_tag
+                        self.next_tag += 1
+                        if not no_ack:
+                            self.unacked[tag] = (queue, got)
                         body = base64.b64decode(got)
                         self._send_method(
                             channel, aq.BASIC_GET_OK,
-                            struct.pack(">QB", 1, 0)
+                            struct.pack(">QB", tag, 0)
                             + aq.shortstr("") + aq.shortstr(queue)
                             + struct.pack(">I", 0))
                         self._send_frame(
                             aq.FRAME_HEADER, channel,
                             struct.pack(">HHQ", 60, 0, len(body))
                             + struct.pack(">H", 0))
-                        self._send_frame(aq.FRAME_BODY, channel, body)
+                        if body:  # zero-length bodies carry NO body
+                            # frame (AMQP 0-9-1 §4.2.6; readers stop
+                            # at the header's body-size)
+                            self._send_frame(aq.FRAME_BODY, channel,
+                                             body)
+                elif cm == aq.BASIC_REJECT:
+                    tag, = struct.unpack_from(">Q", args)
+                    requeue = bool(args[8]) if len(args) > 8 else False
+                    held = self.unacked.pop(tag, None)
+                    if held is not None:
+                        _release_unacked(self.store, self.token,
+                                         [held], requeue)
+                    # basic.reject has no -ok reply
                 elif cm == aq.CONN_CLOSE:
                     return
         except (ConnectionError, TimeoutError, OSError, struct.error):
             return
+        finally:
+            # the broker requeues everything an expiring connection
+            # still held — the semaphore-breaking behavior under test
+            if self.unacked:
+                try:
+                    _release_unacked(self.store, self.token,
+                                     list(self.unacked.values()), True)
+                except OSError:
+                    pass
 
 
 class Server(socketserver.ThreadingTCPServer):
@@ -193,6 +305,9 @@ def serve(argv=None) -> None:
     args = parse_args(sys.argv[1:] if argv is None else argv)
     Handler.store = Store(args.data)
     Handler.mean_latency = args.mean_latency
+    recovered = _recover_unacked(Handler.store, args.port)
+    if recovered:
+        print(f"amqp-sim recovered {recovered} unacked deliveries")
     srv = Server(("127.0.0.1", args.port), Handler)
     print(f"amqp-sim {args.name} serving on {args.port}, "
           f"data={args.data}")
