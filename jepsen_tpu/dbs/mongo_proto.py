@@ -80,6 +80,16 @@ class MongoConn:
             "writeConcern": {"w": w},
         })
 
+    def find_and_modify(self, db: str, coll: str, query: dict | None
+                        = None, sort: dict | None = None,
+                        remove: bool = False) -> dict:
+        cmd: dict = {"findAndModify": coll, "query": query or {}}
+        if sort:
+            cmd["sort"] = sort
+        if remove:
+            cmd["remove"] = True
+        return self.command(db, cmd)
+
     def update(self, db: str, coll: str, q: dict, u: dict,
                upsert: bool = False, w="majority") -> dict:
         """Returns the server reply; reply['n'] is matched docs."""

@@ -69,8 +69,41 @@ class Handler(socketserver.BaseRequestHandler):
             return self._update(db, cmd)
         if name == "find":
             return self._find(db, cmd)
+        if name == "findAndModify":
+            return self._find_and_modify(db, cmd)
         return {"ok": 0, "errmsg": f"no such command: '{name}'",
                 "code": 59}
+
+    def _find_and_modify(self, db: str, cmd: dict) -> dict:
+        """Only the remove-oldest shape the logger workload uses
+        (mongodb_rocks.clj:113-121: sort + remove=true)."""
+        key = f"{db}.{cmd['findAndModify']}"
+        q = cmd.get("query") or {}
+        sort = cmd.get("sort") or {}
+        if not cmd.get("remove"):
+            return {"ok": 0, "errmsg": "only remove supported"}
+
+        def fam(data):
+            colls = dict(data.get("colls") or {})
+            coll = list(colls.get(key) or [])
+            hits = [d for d in coll if _matches(d, q)]
+            if sort:
+                field, direction = next(iter(sort.items()))
+                # docs missing the sort field order last (and never
+                # TypeError against typed values)
+                hits.sort(key=lambda d: ((d.get(field) is None),
+                                         d.get(field) or 0),
+                          reverse=direction < 0)
+            if not hits:
+                return {"ok": 1, "value": None}, None
+            victim = hits[0]
+            coll.remove(victim)
+            colls[key] = coll
+            new = dict(data)
+            new["colls"] = colls
+            return {"ok": 1, "value": victim}, new
+
+        return self.store.transact(fam)
 
     def _insert(self, db: str, cmd: dict) -> dict:
         key = f"{db}.{cmd['insert']}"

@@ -26,6 +26,7 @@ import itertools
 import logging
 import random
 import socket
+import time
 
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, models, osdist
@@ -171,6 +172,67 @@ class DocumentCasClient(client.Client):
             self.conn.close()
 
 
+class LoggerClient(client.Client):
+    """The mongodb-rocks logger/queue perf client
+    (mongodb_rocks.clj:85-134): write = insert a timestamped document
+    keyed by the generator's unique id; delete = findAndModify-remove
+    the OLDEST document (sort time ascending). No linearizability
+    model — the workload exists to hammer the storage engine and plot
+    latency (checker = perf only, :157-168)."""
+
+    def __init__(self, conn=None, payload_bytes: int = 64):
+        self.conn = conn
+        self.payload = "x" * payload_bytes
+
+    def open(self, test, node):
+        conn = mongo_proto.MongoConn(node_host(test, node),
+                                     node_port(test, node))
+        return LoggerClient(conn, len(self.payload))
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                res = self.conn.insert(
+                    DB_NAME, "logger",
+                    [{"_id": op.value,
+                      "time": int(time.time() * 1000),
+                      "payload": self.payload}],
+                    w="acknowledged")
+                if res.get("writeErrors"):
+                    # a server-side write error is a DEFINITE
+                    # non-application (e.g. duplicate _id), not an
+                    # indeterminate timeout
+                    return op.with_(type="fail",
+                                    error=str(res["writeErrors"][0]))
+                return op.with_(type="ok")
+            if op.f == "delete":
+                res = self.conn.find_and_modify(
+                    DB_NAME, "logger", query={}, sort={"time": 1},
+                    remove=True)
+                doc = res.get("value")
+                if doc is None:
+                    return op.with_(type="fail")
+                return op.with_(type="ok", value=doc.get("_id"))
+            raise ValueError(f"unknown op {op.f!r}")
+        except (mongo_proto.MongoError, socket.timeout, TimeoutError,
+                ConnectionError, OSError) as e:
+            return op.with_(type="info", error=str(e))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def logger_write(test, process):
+    # timestamped unique id, the reference's "<epoch>-oempa_<rand>"
+    return {"type": "invoke", "f": "write",
+            "value": f"{int(time.time())}-oempa_{random.randrange(2**31)}"}
+
+
+def logger_delete(test, process):
+    return {"type": "invoke", "f": "delete", "value": None}
+
+
 class TransferClient(client.Client):
     """Bank transfers across account documents WITHOUT transactions
     (transfer.clj:1-281): read each balance, conditionally CAS each
@@ -305,6 +367,17 @@ def workloads(opts: dict) -> dict:
             }),
             "test_opts": {"accounts_n": n},
         },
+        # mongodb-rocks's logger-perf-test (mongodb_rocks.clj:157-168):
+        # 2:1 timestamped inserts vs remove-oldest, latency plots only
+        "logger-perf": {
+            "client": LoggerClient(),
+            "during": gen.stagger(
+                opts.get("stagger", 0.01),
+                gen.mix([logger_write, logger_write, logger_delete])),
+            "checker": checker_mod.compose({
+                "perf": checker_mod.perf_checker(),
+            }),
+        },
     }
 
 
@@ -354,7 +427,7 @@ def mongodb_smartos_test(opts: dict) -> dict:
 def _opt_spec(p) -> None:
     cmn.nemesis_opt(p)
     p.add_argument("--workload", default="document-cas",
-                   choices=["document-cas", "transfer"])
+                   choices=["document-cas", "transfer", "logger-perf"])
     p.add_argument("--archive-url", dest="archive_url", default=None)
     p.add_argument("--flavor", default="rocks",
                    choices=["rocks", "smartos"])

@@ -42,6 +42,7 @@ TABLE_CREATE = 60
 BRANCH = 65
 FUNC = 69
 DEFAULT = 92
+RECONFIGURE = 176
 
 
 class ReqlError(Exception):
@@ -119,6 +120,17 @@ def table_create(db_term, name, replicas=None):
     opts = {"replicas": replicas} if replicas else {}
     return ([TABLE_CREATE, [db_term, name], opts] if opts
             else [TABLE_CREATE, [db_term, name]])
+
+
+def reconfigure(table_term, shards: int, replicas: dict,
+                primary_replica_tag: str):
+    """r.table(...).reconfigure({shards, replicas: {tag: n...},
+    primary_replica_tag}) — the topology-change call the reconfigure
+    nemesis drives (rethinkdb.clj:180-194)."""
+    return [RECONFIGURE, [table_term],
+            {"shards": shards,
+             "replicas": datum(replicas),
+             "primary_replica_tag": primary_replica_tag}]
 
 
 class ReqlConn:

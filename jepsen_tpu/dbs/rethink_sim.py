@@ -76,6 +76,26 @@ class Interp:
         self.dirty = True
         return {"tables_created": 1}
 
+    def t_176(self, args, opts):  # RECONFIGURE
+        """Topology change (rethinkdb.clj:180-194's r.reconfigure).
+        The sim keeps the replica map as table metadata — data stays
+        shared-store-global like a fully replicated table — and
+        answers {reconfigured: 1} like a healthy cluster."""
+        _, dbname, tname = self.eval(args[0])
+        replicas = self.eval(opts.get("replicas") or {})
+        primary = self.eval(opts.get("primary_replica_tag"))
+        if primary is not None and replicas and primary not in replicas:
+            raise rp.ReqlError(
+                rp.RUNTIME_ERROR,
+                f"Could not find any servers with server tag "
+                f"`{primary}`")
+        topo = self.data.setdefault("topology", {})
+        topo[f"{dbname}.{tname}"] = {"shards": self.eval(
+            opts.get("shards", 1)), "replicas": replicas,
+            "primary": primary}
+        self.dirty = True
+        return {"reconfigured": 1}
+
     def t_15(self, args, opts):  # TABLE
         _, dbname = self.eval(args[0])
         name = self.eval(args[1])

@@ -19,6 +19,7 @@ import socket
 
 from .. import checker as checker_mod
 from .. import cli, client, generator as gen, independent, models
+from .. import nemesis as nemesis_mod
 from .. import osdist
 from ..history import Op
 from . import rethink_proto as rp
@@ -142,6 +143,77 @@ class DocumentCasClient(client.Client):
             self.conn.close()
 
 
+class ReconfigureNemesis(nemesis_mod.Nemesis):
+    """Randomly reconfigures the table's topology: a random replica
+    subset with a random primary, applied via ReQL reconfigure on the
+    chosen primary, retried on the transient server-tag/unreachable
+    errors a mid-partition cluster throws (rethinkdb.clj:196-231)."""
+
+    RETRIES = 10
+
+    def __init__(self, db_name: str = DB_NAME, table_name: str = TBL):
+        self.db_name = db_name
+        self.table_name = table_name
+
+    def invoke(self, test, op):
+        assert op.f == "reconfigure", op.f
+        last: Exception | None = None
+        for _ in range(self.RETRIES):
+            nodes = list(test["nodes"])
+            replicas = random.sample(nodes,
+                                     1 + random.randrange(len(nodes)))
+            primary = random.choice(replicas)
+            try:
+                conn = rp.ReqlConn(node_host(test, primary),
+                                   node_port(test, primary))
+            except OSError as e:
+                last = e
+                continue
+            try:
+                res = conn.run(rp.reconfigure(
+                    rp.table(rp.db(self.db_name), self.table_name),
+                    shards=1,
+                    replicas={n: 1 for n in replicas},
+                    primary_replica_tag=primary,
+                ))
+                if res.get("reconfigured") != 1:
+                    raise rp.ReqlError(rp.RUNTIME_ERROR, str(res))
+                return op.with_(value={"replicas": replicas,
+                                       "primary": primary})
+            except (rp.ReqlError, OSError) as e:
+                # ConnectionError/timeouts are OSError subclasses; the
+                # only real filter is which ReqlErrors are transient
+                # (rethinkdb.clj:221-231's regex taxonomy)
+                msg = str(e)
+                last = e
+                if (isinstance(e, OSError) or "server tag" in msg
+                        or "unreachable" in msg):
+                    log.warning("reconfigure caught; retrying: %s", msg)
+                    continue
+                raise
+            finally:
+                conn.close()
+        return op.with_(value=f"reconfigure-failed: {last}")
+
+
+def reconfigure_start_stop(t1: float, t2: float) -> gen.Generator:
+    """The reference's nemesis feed: partition start/stop cycling with
+    a reconfigure interposed between every transition
+    (document_cas.clj:176-180's (interpose reconfigure
+    (cycle [start stop])))."""
+
+    def cycle():
+        while True:
+            yield gen.sleep(t1)
+            yield {"type": "info", "f": "start"}
+            yield {"type": "info", "f": "reconfigure"}
+            yield gen.sleep(t2)
+            yield {"type": "info", "f": "stop"}
+            yield {"type": "info", "f": "reconfigure"}
+
+    return gen.seq(cycle())
+
+
 def r(test, process):
     return {"type": "invoke", "f": "read", "value": None}
 
@@ -161,14 +233,32 @@ def rethinkdb_test(opts: dict) -> dict:
     db_ = RethinkDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
+    reconfigure = opts.get("workload") == "reconfigure"
+    if reconfigure and opts.get("read_mode") not in (None, "majority"):
+        # the reconfigure test is majority/majority BY DESIGN — it
+        # "performs only writes and cas ops to prove that data loss
+        # isn't just due to stale reads" (document_cas.clj:150-153);
+        # silently ignoring the flag would misreport what was tested
+        raise ValueError(
+            "--workload reconfigure pins --read-mode majority "
+            f"(got {opts['read_mode']!r})")
     test.update(
         {
-            "name": "rethinkdb document-cas",
+            "name": ("rethinkdb document reconfigure" if reconfigure
+                     else "rethinkdb document-cas"),
             "os": osdist.debian,
             "db": db_,
             "client": DocumentCasClient(
-                read_mode=opts.get("read_mode", "majority")),
-            "nemesis": cmn.pick_nemesis(db_, opts),
+                read_mode=("majority" if reconfigure
+                           else opts.get("read_mode", "majority"))),
+            "nemesis": (
+                # topology changes composed with partitions
+                # (document_cas.clj:181-185)
+                nemesis_mod.compose({
+                    frozenset({"reconfigure"}): ReconfigureNemesis(),
+                    frozenset({"start", "stop"}):
+                        cmn.pick_nemesis(db_, opts),
+                }) if reconfigure else cmn.pick_nemesis(db_, opts)),
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -180,7 +270,8 @@ def rethinkdb_test(opts: dict) -> dict:
             "generator": gen.time_limit(
                 opts.get("time_limit", 60),
                 gen.nemesis(
-                    gen.start_stop(10, 10),
+                    (reconfigure_start_stop(10, 10) if reconfigure
+                     else gen.start_stop(10, 10)),
                     independent.concurrent_generator(
                         opts.get("threads_per_key", 2),
                         itertools.count(),
@@ -202,6 +293,8 @@ def _opt_spec(p) -> None:
     p.add_argument("--archive-url", dest="archive_url", default=None)
     p.add_argument("--read-mode", dest="read_mode", default="majority",
                    choices=["single", "majority", "outdated"])
+    p.add_argument("--workload", default="cas",
+                   choices=["cas", "reconfigure"])
 
 
 def main(argv=None) -> None:

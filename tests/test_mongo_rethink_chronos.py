@@ -105,6 +105,56 @@ class TestMongo:
         assert result["results"]["valid"] is True, result["results"]
         assert t["name"].startswith("mongodb-rocks")
 
+    def test_logger_client(self, mongo_port):
+        """mongodb-rocks's logger workload: timestamped inserts,
+        findAndModify-remove-oldest (mongodb_rocks.clj:85-134)."""
+        t = {"mongodb": {"addr_fn": lambda n: "127.0.0.1",
+                         "ports": {"n1": mongo_port}}}
+        c = mongodb.LoggerClient().open(t, "n1")
+        # empty queue: delete fails
+        assert c.invoke(t, Op(0, "invoke", "delete", None)).type == \
+            "fail"
+        # generator shape sanity: timestamped unique ids
+        assert "-oempa_" in mongodb.logger_write(t, 0)["value"]
+        for i in range(3):
+            assert c.invoke(
+                t, Op(0, "invoke", "write", f"id-{i}")).type == "ok"
+        # removes come back oldest-first
+        d1 = c.invoke(t, Op(0, "invoke", "delete", None))
+        assert d1.type == "ok" and d1.value == "id-0"
+        d2 = c.invoke(t, Op(0, "invoke", "delete", None))
+        assert d2.value == "id-1"
+        c.close(t)
+
+    def test_full_run_logger(self, tmp_path):
+        nodes = ["n1"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "mongo.tar.gz")
+        mongo_sim.build_archive(archive, str(tmp_path / "s" / "m.json"))
+        t = mongodb.mongodb_rocks_test({
+            "workload": "logger-perf",
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "mongodb": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 3,
+            "stagger": 0.005,
+        })
+        t["os"] = None
+        t["net"] = None
+        t["nemesis"] = nemesis.noop
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+        oks = [o for o in result["history"]
+               if o.type == "ok" and o.f in ("write", "delete")]
+        assert len(oks) > 10
+
 
 @pytest.fixture
 def rethink_port(tmp_path):
@@ -166,6 +216,84 @@ class TestRethink:
         t["nemesis"] = nemesis.noop
         result = core.run(t)
         assert result["results"]["valid"] is True, result["results"]
+
+    def test_reconfigure_term_and_nemesis(self, rethink_port):
+        """The ReQL reconfigure term round-trips through the sim, and
+        ReconfigureNemesis applies a random topology with retries
+        (rethinkdb.clj:180-231)."""
+        from jepsen_tpu.dbs import rethink_proto as rp
+
+        t = {"rethinkdb": {"addr_fn": lambda n: "127.0.0.1",
+                           "ports": {"n1": rethink_port,
+                                     "n2": rethink_port}},
+             "nodes": ["n1", "n2"]}
+        c = rp.ReqlConn("127.0.0.1", rethink_port)
+        c.run(rp.db_create(rethinkdb.DB_NAME))
+        c.run(rp.table_create(rp.db(rethinkdb.DB_NAME), rethinkdb.TBL))
+        res = c.run(rp.reconfigure(
+            rp.table(rp.db(rethinkdb.DB_NAME), rethinkdb.TBL),
+            shards=1, replicas={"n1": 1}, primary_replica_tag="n1"))
+        assert res == {"reconfigured": 1}
+        # bad primary tag -> the retriable server-tag error
+        with pytest.raises(rp.ReqlError, match="server tag"):
+            c.run(rp.reconfigure(
+                rp.table(rp.db(rethinkdb.DB_NAME), rethinkdb.TBL),
+                shards=1, replicas={"n1": 1},
+                primary_replica_tag="nope"))
+        c.close()
+        nem = rethinkdb.ReconfigureNemesis().setup(t)
+        done = nem.invoke(t, Op(0, "info", "reconfigure", None))
+        assert isinstance(done.value, dict), done
+        assert done.value["primary"] in done.value["replicas"]
+
+    def test_full_run_reconfigure(self, tmp_path):
+        """--workload reconfigure: topology changes mid-run (composed
+        with the partition slot, noop'd hermetically) with verdicts
+        still linearizable on the healthy sim."""
+        nodes = ["n1", "n2"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "rethink.tar.gz")
+        rethink_sim.build_archive(archive, str(tmp_path / "s" / "r.json"))
+        t = rethinkdb.rethinkdb_test({
+            "nodes": nodes,
+            "remote": remote,
+            "archive_url": f"file://{archive}",
+            "workload": "reconfigure",
+            "rethinkdb": {
+                "addr_fn": lambda n: "127.0.0.1",
+                "ports": {n: free_port() for n in nodes},
+                "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+                "sudo": None,
+            },
+            "concurrency": 4,
+            "time_limit": 5,
+            "ops_per_key": 20,
+            "stagger": 0.01,
+        })
+        assert t["name"] == "rethinkdb document reconfigure"
+        t["os"] = None
+        t["net"] = None
+        from jepsen_tpu import generator as gen, nemesis as nem_mod
+
+        # keep the reconfigure slot live; noop the partition slot
+        t["nemesis"] = nem_mod.compose({
+            frozenset({"reconfigure"}): rethinkdb.ReconfigureNemesis(),
+            frozenset({"start", "stop"}): nemesis.noop,
+        })
+        import itertools as it
+
+        t["generator"] = gen.time_limit(5, gen.nemesis(
+            rethinkdb.reconfigure_start_stop(0.5, 0.5),
+            independent.concurrent_generator(
+                2, it.count(),
+                lambda k: gen.limit(20, gen.stagger(0.01, gen.mix(
+                    [rethinkdb.r, rethinkdb.w, rethinkdb.cas])))),
+        ))
+        result = core.run(t)
+        assert result["results"]["valid"] is True, result["results"]
+        recfg = [o for o in result["history"]
+                 if o.f == "reconfigure" and isinstance(o.value, dict)]
+        assert recfg, "no reconfigure ever applied"
 
 
 class TestChronosChecker:
