@@ -134,6 +134,22 @@ def _tpu_eligible(model, es) -> bool:
     return jm.lane_eligible(es)
 
 
+def _combine_lanes(rs: list):
+    """One WGLResult for a P-compositionally decomposed history: valid
+    iff every lane is (locality — ops/pcomp.py); an invalid lane's
+    counterexample is the history's counterexample (its ops are real
+    ops of the full history); step counts sum."""
+    steps = sum(getattr(r, "steps", 0) or 0 for r in rs)
+    for r in rs:
+        if r.valid is False:
+            return wgl_host.WGLResult(
+                valid=False, op=r.op,
+                best_linearization=r.best_linearization, steps=steps)
+    if any(r.valid == "unknown" for r in rs):
+        return wgl_host.WGLResult(valid="unknown", steps=steps)
+    return wgl_host.WGLResult(valid=True, steps=steps)
+
+
 class Linearizable(Checker):
     def __init__(
         self,
@@ -157,6 +173,21 @@ class Linearizable(Checker):
         es = make_entries(history)
         algorithm = self.algorithm
         if algorithm == "auto":
+            # P-compositional fast path: an unordered-queue history
+            # decomposes by value into micro-lanes (ops/pcomp.py) —
+            # the exponential interleaving search collapses into a
+            # batch of trivial ones.
+            from ..ops import pcomp
+
+            if pcomp.eligible(model):
+                lanes = pcomp.split(es)
+                if lanes is not None:
+                    rs = self._auto_results(
+                        model, lanes, self._steps_budget(),
+                        deadline=self._deadline())
+                    d = self._result(_combine_lanes(rs))
+                    self._render_invalid(test, history, d, opts)
+                    return d
             # for ONE history the sequential C++ engine wins outright:
             # a TPU kernel launch costs more than most whole searches,
             # and a single lane can't amortize it (BENCH_r03
@@ -222,15 +253,7 @@ class Linearizable(Checker):
             results[i] = d
 
         algorithm = self.algorithm
-        batch_kw = {}
-        if self.time_limit is not None:
-            # same translation check() relies on (wgl_tpu.analysis):
-            # a while-loop kernel can't consult the wall clock, so the
-            # budget becomes steps via a conservative rate estimate
-            from ..ops import wgl_tpu as _wt
-
-            batch_kw["max_steps"] = max(
-                1000, int(self.time_limit * _wt.STEPS_PER_SEC_ESTIMATE))
+        batch_kw = self._steps_budget()
         if algorithm == "pallas":
             from ..ops import wgl_pallas_vec
 
@@ -251,16 +274,68 @@ class Linearizable(Checker):
                 results[i] = self.check(test, h, o)
             return results
 
-        # ---- auto: native triage + native finish; TPU batch engines
-        # only where no native toolchain exists (policy rationale at
-        # TRIAGE_MAX_STEPS above). Native availability is PER LANE —
-        # a single lane with (say) a payload outside int32 must not
-        # derail the rest of the batch. The C++ engine is stateless
-        # per call and ctypes drops the GIL for its duration, so on
-        # multi-core control nodes lanes fan out over a thread pool
-        # (the reference's bounded-pmap per-key checking,
-        # independent.clj:269-287); results are finished on this
-        # thread — finish() renders SVGs and is not re-entrant. ----
+        # P-compositional preprocessing: unordered-queue histories
+        # decompose by value into micro-lanes (ops/pcomp.py); the
+        # whole batch's lanes flatten into ONE engine pass and each
+        # item's verdict recombines from its own lanes.
+        from ..ops import pcomp
+
+        if pcomp.eligible(model):
+            flat: list = []
+            spans: list = []
+            ok = True
+            for es in ess:
+                lanes = pcomp.split(es)
+                if lanes is None:
+                    ok = False
+                    break
+                spans.append((len(flat), len(flat) + len(lanes)))
+                flat.extend(lanes)
+            if ok:
+                rs = self._auto_results(model, flat, batch_kw,
+                                        deadline=self._deadline())
+                for i, (a, b) in enumerate(spans):
+                    finish(i, _combine_lanes(rs[a:b]))
+                return results
+
+        for i, r in enumerate(self._auto_results(model, ess, batch_kw)):
+            finish(i, r)
+        return results
+
+    def _steps_budget(self) -> dict:
+        """time_limit translated to a per-engine-call step budget (a
+        while-loop kernel can't consult the wall clock, so the budget
+        becomes steps via a conservative rate estimate — the same
+        translation wgl_tpu.analysis applies)."""
+        if self.time_limit is None:
+            return {}
+        from ..ops import wgl_tpu as _wt
+
+        return {"max_steps": max(
+            1000, int(self.time_limit * _wt.STEPS_PER_SEC_ESTIMATE))}
+
+    def _deadline(self):
+        """A wall-clock deadline for decomposed-lane passes: the lanes
+        of ONE logical check share ONE time_limit (per-lane limits
+        would multiply the caller's budget by the lane count)."""
+        import time as _t
+
+        return (None if self.time_limit is None
+                else _t.monotonic() + self.time_limit)
+
+    def _auto_results(self, model, ess, batch_kw,
+                      deadline: float | None = None) -> list:
+        """The batched "auto" engine policy as raw WGLResults: native
+        triage + native finish; TPU batch engines only where no native
+        toolchain exists (policy rationale at TRIAGE_MAX_STEPS above).
+        Native availability is PER LANE — a single lane with (say) a
+        payload outside int32 must not derail the rest of the batch.
+        The C++ engine is stateless per call and ctypes drops the GIL
+        for its duration, so on multi-core control nodes lanes fan out
+        over a thread pool (the reference's bounded-pmap per-key
+        checking, independent.clj:269-287)."""
+        n = len(ess)
+        out: list = [None] * n
         try:
             from ..ops import wgl_native
 
@@ -288,14 +363,23 @@ class Linearizable(Checker):
             if r.valid == "unknown":
                 pending.append(i)
             else:
-                finish(i, r)
+                out[i] = r
+
+        import time as _t
+
+        def lane_limit():
+            """Per-lane wall limit: the shared deadline's remainder
+            when one exists, else the full per-lane time_limit."""
+            if deadline is None:
+                return self.time_limit
+            return max(0.001, deadline - _t.monotonic())
 
         rest = [i for i in pending if not native_ok[i]]
         for i, r in native_map(
                 [i for i in pending if native_ok[i]],
                 lambda i: wgl_native.analysis(
-                    model, ess[i], time_limit=self.time_limit)):
-            finish(i, r)
+                    model, ess[i], time_limit=lane_limit())):
+            out[i] = r
         if rest:
             sub = [ess[i] for i in rest]
             if _pallas_eligible(model, sub):
@@ -304,19 +388,19 @@ class Linearizable(Checker):
                 for i, r in zip(rest,
                                 wgl_pallas_vec.analysis_batch(
                                     model, sub, **batch_kw)):
-                    finish(i, r)
+                    out[i] = r
             elif all(_tpu_eligible(model, es) for es in sub):
                 from ..ops import wgl_tpu
 
                 for i, r in zip(rest,
                                 wgl_tpu.analysis_batch(model, sub,
                                                        **batch_kw)):
-                    finish(i, r)
+                    out[i] = r
             else:
                 for i in rest:
-                    finish(i, wgl_host.analysis(
-                        model, ess[i], time_limit=self.time_limit))
-        return results
+                    out[i] = wgl_host.analysis(
+                        model, ess[i], time_limit=lane_limit())
+        return out
 
     @staticmethod
     def _render_invalid(test, history, d, opts) -> None:

@@ -89,11 +89,14 @@ class Handler(socketserver.BaseRequestHandler):
             hits = [d for d in coll if _matches(d, q)]
             if sort:
                 field, direction = next(iter(sort.items()))
-                # docs missing the sort field order last (and never
-                # TypeError against typed values)
-                hits.sort(key=lambda d: ((d.get(field) is None),
-                                         d.get(field) or 0),
-                          reverse=direction < 0)
+                # docs missing the sort field order last regardless
+                # of direction (so they are never the victim while a
+                # sortable doc exists)
+                present = [d for d in hits if d.get(field) is not None]
+                absent = [d for d in hits if d.get(field) is None]
+                present.sort(key=lambda d: d[field],
+                             reverse=direction < 0)
+                hits = present + absent
             if not hits:
                 return {"ok": 1, "value": None}, None
             victim = hits[0]

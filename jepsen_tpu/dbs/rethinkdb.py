@@ -275,10 +275,15 @@ def rethinkdb_test(opts: dict) -> dict:
                     independent.concurrent_generator(
                         opts.get("threads_per_key", 2),
                         itertools.count(),
+                        # the reconfigure test "performs only writes
+                        # and cas ops to prove that data loss isn't
+                        # just due to stale reads"
+                        # (document_cas.clj:150-153)
                         lambda k: gen.limit(
                             opts.get("ops_per_key", 50),
                             gen.stagger(opts.get("stagger", 0.05),
-                                        gen.mix([r, w, cas])),
+                                        gen.mix([w, cas] if reconfigure
+                                                else [r, w, cas])),
                         ),
                     ),
                 ),

@@ -1,0 +1,79 @@
+"""P-compositional decomposition of unordered-queue histories.
+
+"Faster linearizability checking via P-compositionality" (Horn &
+Kroening, PAPERS.md) observes that when an object is a PRODUCT of
+independent components and every operation touches exactly one
+component, Herlihy-Wing locality applies componentwise: a history is
+linearizable iff each component's projection is. The unordered queue
+(knossos.model/unordered-queue; models/__init__.py:134-149) is exactly
+such a product — its state is a multiset, i.e. one counter per value,
+and enqueue(v)/dequeue(v) read and write only v's counter — so a
+queue history decomposes BY VALUE into micro-histories of a handful
+of ops each. That turns the search knossos finds hardest (BASELINE
+config 4: 10k-op queue histories under a partition nemesis, where
+interleaving count explodes) into thousands of trivial lanes that the
+batched engines clear in one pass.
+
+Soundness notes, matching the reference's semantics exactly:
+- A crashed (:info) dequeue records no value. Knossos's model steps
+  (dequeue, nil) to Inconsistent, so such an entry can never
+  linearize; since crashed entries are optional, it is semantically
+  absent from every linearization and DROPS from the decomposition.
+- A crashed enqueue carries its invoke value and projects normally
+  (it may or may not have landed — exactly what the sub-lane search
+  decides).
+- An OK entry with an op the model doesn't know (or an ok dequeue of
+  a never-enqueued value) makes its own lane invalid, which is the
+  whole history's verdict — same as the undecomposed search.
+- Real-time order is preserved: a projection keeps the RELATIVE order
+  of its call/ret positions, and precedence between two entries is a
+  positional comparison, so re-ranking cannot create or destroy a
+  happens-before edge within a lane. FIFO queues do NOT decompose
+  (order couples values); they stay on the full search.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..history import Entries
+from ..models import UnorderedQueue
+
+
+def eligible(model) -> bool:
+    return isinstance(model, UnorderedQueue) and not model.pending
+
+
+def _subset(es: Entries, idx: list) -> Entries:
+    """Sub-Entries over `idx`, positions re-ranked order-preservingly."""
+    sel = np.asarray(idx, np.int64)
+    pos = np.concatenate([es.call_pos[sel], es.ret_pos[sel]])
+    order = np.argsort(pos, kind="stable")
+    rank = np.empty(len(pos), np.int64)
+    rank[order] = np.arange(len(pos))
+    m = len(idx)
+    return Entries(
+        f=[es.f[i] for i in idx],
+        value_in=[es.value_in[i] for i in idx],
+        value_out=[es.value_out[i] for i in idx],
+        crashed=es.crashed[sel],
+        call_pos=rank[:m],
+        ret_pos=rank[m:],
+        invokes=[es.invokes[i] for i in idx],
+    )
+
+
+def split(es: Entries) -> list | None:
+    """Per-value sub-Entries, or None when the history isn't cleanly
+    decomposable (an unhashable payload — dict-keyed grouping must use
+    the same ==/hash equivalence the model's multiset does)."""
+    groups: dict = {}
+    try:
+        for i, (f, v, crashed) in enumerate(
+                zip(es.f, es.value_out, es.crashed)):
+            if f == "dequeue" and crashed and v is None:
+                continue  # can never linearize; optional -> absent
+            groups.setdefault(v, []).append(i)
+    except TypeError:  # unhashable payload
+        return None
+    return [_subset(es, idx) for idx in groups.values()]

@@ -1,0 +1,134 @@
+"""P-compositional decomposition of unordered-queue histories
+(ops/pcomp.py): the checker's auto path splits by value and must agree
+with the UNDECOMPOSED host search on every verdict — the locality
+argument in the module docstring, pinned empirically here."""
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu.history import (
+    entries as make_entries,
+    index,
+    invoke_op,
+    ok_op,
+    info_op,
+)
+from jepsen_tpu.models import FIFOQueue, UnorderedQueue
+from jepsen_tpu.ops import pcomp, wgl_host
+
+from helpers import random_queue_history
+
+
+def h(*ops):
+    return index(list(ops))
+
+
+class TestSplit:
+    def test_groups_by_value(self):
+        es = make_entries(h(
+            invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+            invoke_op(1, "enqueue", "b"), ok_op(1, "enqueue", "b"),
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", "a"),
+        ))
+        lanes = pcomp.split(es)
+        assert sorted(len(l) for l in lanes) == [1, 2]
+
+    def test_crashed_valueless_dequeue_drops(self):
+        es = make_entries(h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue"), info_op(1, "dequeue"),
+        ))
+        lanes = pcomp.split(es)
+        assert len(lanes) == 1 and len(lanes[0]) == 1
+
+    def test_crashed_enqueue_projects(self):
+        es = make_entries(h(
+            invoke_op(0, "enqueue", 1), info_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        ))
+        (lane,) = pcomp.split(es)
+        assert len(lane) == 2
+
+    def test_unhashable_payload_bails(self):
+        es = make_entries(h(
+            invoke_op(0, "enqueue", {"k": 1}),
+            ok_op(0, "enqueue", {"k": 1}),
+        ))
+        assert pcomp.split(es) is None
+
+    def test_fifo_not_eligible(self):
+        assert not pcomp.eligible(FIFOQueue())
+        assert pcomp.eligible(UnorderedQueue())
+
+    def test_precedence_preserved_in_projection(self):
+        """Two same-value ops strictly ordered in real time must stay
+        ordered in the sub-lane: the invalid it implies survives."""
+        bad = h(
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", "x"),
+            invoke_op(0, "enqueue", "x"), ok_op(0, "enqueue", "x"),
+        )
+        r = checker_mod.linearizable(UnorderedQueue()).check({}, bad, {})
+        assert r["valid"] is False
+        assert r.get("op") is not None
+
+
+class TestVerdictEquivalence:
+    @pytest.mark.parametrize("corrupt", [0.0, 0.25, 0.5])
+    def test_randomized_vs_undecomposed_host(self, corrupt):
+        m = UnorderedQueue()
+        chk = checker_mod.linearizable(m)  # auto: decomposes
+        for s in range(40):
+            hist = random_queue_history(
+                n_process=4, n_ops=16, n_values=4,
+                seed=2100 + s, corrupt=corrupt)
+            es = make_entries(hist)
+            want = wgl_host.analysis(m, es).valid
+            got = chk.check({}, hist, {})["valid"]
+            assert got == want, (s, corrupt)
+
+    def test_batched_through_independent_checker(self):
+        from jepsen_tpu import independent
+
+        m = UnorderedQueue()
+        ops = []
+        for k in ("a", "b"):
+            bad = k == "b"
+            ops += [
+                invoke_op(0, "enqueue", independent.tuple_(k, 1)),
+                ok_op(0, "enqueue", independent.tuple_(k, 1)),
+                invoke_op(1, "dequeue", independent.tuple_(k, None)),
+                ok_op(1, "dequeue",
+                      independent.tuple_(k, 2 if bad else 1)),
+            ]
+        c = independent.checker(checker_mod.linearizable(m))
+        r = c.check({}, index(ops), {})
+        assert r["valid"] is False
+        assert r["failures"] == ["b"]
+
+    def test_time_limit_not_multiplied_by_lanes(self):
+        """The lanes of ONE logical check share ONE wall budget: a
+        per-lane time_limit would multiply the caller's budget by the
+        value count. Deep corrupt lanes under a small limit must
+        return (possibly unknown) in roughly the budget, not
+        lanes x budget."""
+        import time
+
+        hist = random_queue_history(n_process=5, n_ops=1200,
+                                    n_values=6, seed=31, corrupt=0.4)
+        chk = checker_mod.linearizable(UnorderedQueue(),
+                                       time_limit=0.3)
+        t0 = time.monotonic()
+        r = chk.check({}, hist, {})
+        wall = time.monotonic() - t0
+        assert r["valid"] in (True, False, "unknown")
+        assert wall < 5.0, wall  # generous CI margin, not 6 x 0.3 + search
+
+    def test_big_queue_history_fast_and_valid(self):
+        """The BASELINE config-4 shape: a long valid queue history
+        that the full search would grind on resolves through the
+        decomposition (thousands of micro-lanes, one batch pass)."""
+        hist = random_queue_history(n_process=5, n_ops=2000,
+                                    n_values=500, seed=77)
+        r = checker_mod.linearizable(UnorderedQueue()).check(
+            {}, hist, {})
+        assert r["valid"] is True
