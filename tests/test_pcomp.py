@@ -72,6 +72,70 @@ class TestSplit:
         assert r.get("op") is not None
 
 
+class TestAdversarialLiterals:
+    """Crash-pattern edges where a wrong decomposition would diverge
+    from the full search; each is asserted against the host oracle."""
+
+    def _both(self, hist):
+        got = checker_mod.linearizable(UnorderedQueue()).check(
+            {}, hist, {})["valid"]
+        want = wgl_host.analysis(
+            UnorderedQueue(), make_entries(hist)).valid
+        assert got == want
+        return got
+
+    def test_one_crashed_enqueue_cannot_feed_two_dequeues(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1), info_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+            invoke_op(2, "dequeue"), ok_op(2, "dequeue", 1),
+        )
+        assert self._both(hist) is False
+
+    def test_two_enqueues_one_crashed_feed_two_dequeues(self):
+        hist = h(
+            invoke_op(0, "enqueue", 1), info_op(0, "enqueue", 1),
+            invoke_op(3, "enqueue", 1), ok_op(3, "enqueue", 1),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+            invoke_op(2, "dequeue"), ok_op(2, "dequeue", 1),
+        )
+        assert self._both(hist) is True
+
+    def test_cross_value_innocence(self):
+        """An invalid value-b lane must not leak validity from value
+        a's abundant supply."""
+        hist = h(
+            invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+            invoke_op(1, "enqueue", "a"), ok_op(1, "enqueue", "a"),
+            invoke_op(2, "dequeue"), ok_op(2, "dequeue", "b"),
+        )
+        assert self._both(hist) is False
+
+    def test_dequeue_strictly_before_matching_enqueue(self):
+        hist = h(
+            invoke_op(0, "dequeue"), ok_op(0, "dequeue", 7),
+            invoke_op(1, "enqueue", 7), ok_op(1, "enqueue", 7),
+        )
+        assert self._both(hist) is False
+
+    def test_concurrent_enqueue_dequeue_same_value(self):
+        hist = h(
+            invoke_op(0, "enqueue", 7),
+            invoke_op(1, "dequeue"),
+            ok_op(0, "enqueue", 7),
+            ok_op(1, "dequeue", 7),
+        )
+        assert self._both(hist) is True
+
+    def test_pending_enqueue_counts_as_optional(self):
+        # invoke with no completion at all: optional, may have landed
+        hist = h(
+            invoke_op(0, "enqueue", 5),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", 5),
+        )
+        assert self._both(hist) is True
+
+
 class TestVerdictEquivalence:
     @pytest.mark.parametrize("corrupt", [0.0, 0.25, 0.5])
     def test_randomized_vs_undecomposed_host(self, corrupt):
