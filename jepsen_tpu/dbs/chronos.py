@@ -30,7 +30,7 @@ from .. import cli, client, generator as gen, osdist
 from ..checker import Checker
 from ..history import Op, ops as _ops
 from ..util import real_pmap
-from .common import ArchiveDB, SuiteCfg, ready_gated_final
+from .common import SuiteCfg, ready_gated_final
 
 log = logging.getLogger("jepsen_tpu.dbs.chronos")
 
@@ -47,17 +47,85 @@ def job_dir(test) -> str:
     return _suite.cfg(test).get("job_dir", "/tmp/chronos-test")
 
 
-class ChronosDB(ArchiveDB):
+MASTER_COUNT = 3          # mesosphere.clj:17
+ZK_PORT = 2181
+MESOS_PORT = 5050
+
+
+class ChronosDB(cmn.MultiDaemonDB):
+    """The real mesosphere stack per the reference: zookeeper on every
+    node, mesos-master on the first MASTER_COUNT sorted nodes and
+    mesos-slave on the rest (mesosphere.clj:57-119's role split),
+    chronos on every node (chronos.clj:56-83 layers it over
+    mesosphere/db). Bring-up is readiness-gated in dependency order
+    zk -> mesos -> chronos; teardown reverses it (chronos.clj:73-78
+    stops chronos first, then the mesosphere teardown). The chronos
+    sim gates its scheduler API on the node's zookeeper, so the
+    kill-zk nemesis is client-observable."""
+
     binary = "chronos"
     log_name = "chronos.log"
     pid_name = "chronos.pid"
+
+    ROLES = ("zk", "mesos-master", "mesos-slave", "chronos")
+    ROLE_TAG = {"zk": "zookeeper", "mesos-master": "mesos-master",
+                "mesos-slave": "mesos-slave", "chronos": "chronos"}
+    ROLE_BIN = {"zk": "zookeeper-server",
+                "mesos-master": "mesos-master",
+                "mesos-slave": "mesos-slave", "chronos": "chronos"}
+    STOP_ORDER = ("chronos", "mesos-slave", "mesos-master", "zk")
 
     def __init__(self, archive_url: str | None = None,
                  ready_timeout: float = 60.0):
         super().__init__(_suite, archive_url, ready_timeout)
 
+    # ---- role placement (mesosphere.clj:60-71,93-100) ----
+
+    def masters(self, test) -> list:
+        return sorted(test["nodes"])[:MASTER_COUNT]
+
+    def role_nodes(self, test, role) -> list:
+        if role == "mesos-master":
+            return self.masters(test)
+        if role == "mesos-slave":
+            return [n for n in sorted(test["nodes"])
+                    if n not in self.masters(test)]
+        return list(test["nodes"])
+
+    def role_port(self, test, node, role) -> int:
+        if role == "chronos":
+            return node_port(test, node)
+        if role == "zk":
+            ports = _suite.cfg(test).get("zk_ports")
+            return ports[node] if ports else ZK_PORT
+        ports = _suite.cfg(test).get("mesos_ports")
+        return ports[node] if ports else MESOS_PORT
+
+    def zk_uri(self, test) -> str:
+        """zk://host:port,.../mesos (mesosphere.clj:38-46)."""
+        return "zk://" + ",".join(
+            f"{node_host(test, n)}:{self.role_port(test, n, 'zk')}"
+            for n in test["nodes"]) + "/mesos"
+
+    def role_args(self, test, node, role) -> list:
+        port = self.role_port(test, node, role)
+        if role == "zk":
+            return ["--port", str(port)]
+        if role == "mesos-master":
+            quorum = len(self.masters(test)) // 2 + 1
+            return ["--port", str(port), "--role", "master",
+                    "--zk", self.zk_uri(test), "--quorum", str(quorum)]
+        if role == "mesos-slave":
+            return ["--port", str(port), "--role", "slave",
+                    "--master", self.zk_uri(test)]
+        return ["--port", str(port),
+                "--zk-port", str(self.role_port(test, node, "zk")),
+                "--master", self.zk_uri(test)]
+
+    # the base-class single-daemon surface (shared start-kill /
+    # hammer-time nemeses) targets the chronos scheduler itself
     def daemon_args(self, test, node) -> list:
-        return ["--port", str(node_port(test, node))]
+        return self.role_args(test, node, "chronos")
 
     def probe_ready(self, test, node) -> bool:
         url = (f"http://{node_host(test, node)}:{node_port(test, node)}"
@@ -66,9 +134,17 @@ class ChronosDB(ArchiveDB):
             return resp.status == 200
 
     def setup(self, test, node) -> None:
-        test["remote"].exec(node, ["mkdir", "-p", job_dir(test)],
-                            check=False)
-        super().setup(test, node)
+        remote = test["remote"]
+        remote.exec(node, ["mkdir", "-p", job_dir(test)], check=False)
+        self.install(test, node)
+        self.start_component(test, node, "zk")
+        self._await_ports(test, "zk", self.ready_timeout)
+        for mesos_role in ("mesos-master", "mesos-slave"):
+            if node in self.role_nodes(test, mesos_role):
+                self.start_component(test, node, mesos_role)
+        self._await_ports(test, "mesos-master", self.ready_timeout)
+        self.start_component(test, node, "chronos")
+        self.await_ready(test, node)
 
     def teardown(self, test, node) -> None:
         super().teardown(test, node)
@@ -282,13 +358,20 @@ def chronos_test(opts: dict) -> dict:
     db_ = ChronosDB(archive_url=opts.get("archive_url"))
     test = noop_test()
     test.update(opts)
+    # component killers per stack role (the tidb/NDB surface): kill
+    # one node's zookeeper/mesos daemon while the rest keep serving
+    extra = {
+        f"kill-{role}": (lambda role=role: cmn.ComponentKiller(
+            db_, role))
+        for role in ("zk", "mesos-master", "mesos-slave", "chronos")
+    }
     test.update(
         {
             "name": "chronos",
             "os": osdist.debian,
             "db": db_,
             "client": ChronosClient(),
-            "nemesis": cmn.pick_nemesis(db_, opts),
+            "nemesis": cmn.pick_nemesis(db_, opts, extra=extra),
             "generator": gen.phases(
                 gen.time_limit(
                     opts.get("time_limit", 120),
@@ -315,8 +398,12 @@ def chronos_test(opts: dict) -> dict:
     return test
 
 
+COMPONENT_NEMESES = ("kill-zk", "kill-mesos-master",
+                     "kill-mesos-slave", "kill-chronos")
+
+
 def _opt_spec(p) -> None:
-    cmn.nemesis_opt(p)
+    cmn.nemesis_opt(p, names=cmn.NEMESIS_NAMES + COMPONENT_NEMESES)
     p.add_argument("--archive-url", dest="archive_url", default=None)
 
 

@@ -4,7 +4,14 @@ repeating schedule, GET /scheduler/jobs — and, crucially, it actually
 RUNS each job's shell command at the scheduled times (with bash, like
 real Chronos executes on Mesos agents), so the suite's read-runs path
 (parsing the run files jobs write) works identically against the sim
-and a real cluster."""
+and a real cluster.
+
+With --zk-port, the scheduler API is GATED on the local zookeeper
+being reachable: real Chronos keeps its state and leader election in
+zk (mesosphere.clj:38-46's zk:// URI), so a node that loses zk
+answers 500 until it returns — which makes the suite's kill-zk
+component nemesis observable at the client, not just in the process
+table."""
 
 from __future__ import annotations
 
@@ -19,7 +26,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .simbase import Store, build_sim_archive
+from .simbase import Store
 
 
 def parse_iso8601_interval(s: str) -> tuple:
@@ -59,11 +66,32 @@ class Runner(threading.Thread):
 class Handler(BaseHTTPRequestHandler):
     store: Store = None  # type: ignore[assignment]
     mean_latency: float = 0.0
+    zk_port: int | None = None
+    _zk_cache: tuple = (0.0, True)  # (checked_at, ok) — shared, racy-ok
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):
         sys.stdout.write("%s - %s\n" % (self.address_string(), fmt % args))
         sys.stdout.flush()
+
+    def _zk_ok(self) -> bool:
+        """TCP probe of the node's zookeeper, cached ~0.5s."""
+        if self.zk_port is None:
+            return True
+        import socket
+
+        checked, ok = Handler._zk_cache
+        now = time.monotonic()
+        if now - checked < 0.5:
+            return ok
+        try:
+            with socket.create_connection(("127.0.0.1", self.zk_port),
+                                          timeout=0.5):
+                ok = True
+        except OSError:
+            ok = False
+        Handler._zk_cache = (now, ok)
+        return ok
 
     def _reply(self, status: int, body) -> None:
         payload = (body if isinstance(body, bytes)
@@ -80,8 +108,12 @@ class Handler(BaseHTTPRequestHandler):
         if not self.path.startswith("/scheduler/iso8601"):
             return self._reply(404, {"error": "no route"})
         length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)  # always drain: HTTP/1.1
+        # keep-alive would parse an unread body as the next request
+        if not self._zk_ok():
+            return self._reply(500, {"error": "lost zookeeper"})
         try:
-            job = json.loads(self.rfile.read(length))
+            job = json.loads(body)
             parse_iso8601_interval(job["schedule"])  # validate
         except (json.JSONDecodeError, KeyError, ValueError) as e:
             return self._reply(400, {"error": str(e)})
@@ -100,6 +132,8 @@ class Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if not self.path.startswith("/scheduler/jobs"):
             return self._reply(404, {"error": "no route"})
+        if not self._zk_ok():
+            return self._reply(500, {"error": "lost zookeeper"})
 
         def read(data):
             return list((data.get("jobs") or {}).values()), None
@@ -115,6 +149,7 @@ def parse_args(argv):
     p.add_argument("--port", type=int, default=4400)
     p.add_argument("--name", default="sim")
     p.add_argument("--master", default=None)  # mesos flag, tolerated
+    p.add_argument("--zk-port", dest="zk_port", type=int, default=None)
     return p.parse_args(argv)
 
 
@@ -122,6 +157,7 @@ def serve(argv=None) -> None:
     args = parse_args(sys.argv[1:] if argv is None else argv)
     Handler.store = Store(args.data)
     Handler.mean_latency = args.mean_latency
+    Handler.zk_port = args.zk_port
     httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
     print(f"chronos-sim {args.name} serving on {args.port}, "
           f"data={args.data}")
@@ -131,8 +167,19 @@ def serve(argv=None) -> None:
 
 def build_archive(dest: str, data_path: str, mean_latency: float = 0.0,
                   python: str | None = None) -> str:
-    return build_sim_archive(
-        dest, "jepsen_tpu.dbs.chronos_sim", "chronos", "chronos-sim",
+    """The mesosphere-stack archive (mesosphere.clj + chronos.clj):
+    zookeeper, mesos-master, mesos-slave, and chronos launchers —
+    every role the real topology runs, sharing one state file."""
+    from .simbase import build_multi_sim_archive
+
+    return build_multi_sim_archive(
+        dest, "chronos-sim",
+        {
+            "chronos": "jepsen_tpu.dbs.chronos_sim",
+            "zookeeper-server": "jepsen_tpu.dbs.zk_sim",
+            "mesos-master": "jepsen_tpu.dbs.mesos_sim",
+            "mesos-slave": "jepsen_tpu.dbs.mesos_sim",
+        },
         data_path, mean_latency=mean_latency, python=python,
     )
 

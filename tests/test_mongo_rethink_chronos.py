@@ -357,6 +357,8 @@ class TestChronosEndToEnd:
             "chronos": {
                 "addr_fn": lambda n: "127.0.0.1",
                 "ports": {n: free_port() for n in nodes},
+                "zk_ports": {n: free_port() for n in nodes},
+                "mesos_ports": {n: free_port() for n in nodes},
                 "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
                 "sudo": None,
                 "job_dir": jdir,
@@ -380,3 +382,81 @@ class TestChronosEndToEnd:
         reads = [o for o in result["history"]
                  if o.type == "ok" and o.f == "read"]
         assert reads and reads[-1].value["runs"], "no runs recorded"
+
+    def test_stack_topology_and_zk_gate(self, tmp_path):
+        """The real mesosphere stack (mesosphere.clj:57-119): zk +
+        mesos per node with the master/slave role split, and killing a
+        node's zookeeper makes ITS chronos answer 500 (the sim gates
+        the scheduler API on zk) while other nodes keep serving."""
+        import urllib.error
+        import urllib.request
+
+        nodes = ["n1", "n2", "n3", "n4"]
+        remote = LocalRemote(root=str(tmp_path / "nodes"))
+        archive = str(tmp_path / "chronos.tar.gz")
+        chronos_sim.build_archive(archive, str(tmp_path / "s" / "c.json"))
+        cfg = {
+            "addr_fn": lambda n: "127.0.0.1",
+            "ports": {n: free_port() for n in nodes},
+            "zk_ports": {n: free_port() for n in nodes},
+            "mesos_ports": {n: free_port() for n in nodes},
+            "dir": lambda n: os.path.join(remote.node_dir(n), "opt"),
+            "sudo": None,
+            "job_dir": str(tmp_path / "jobruns"),
+        }
+        t = {"nodes": nodes, "remote": remote, "chronos": cfg,
+             "archive_url": f"file://{archive}"}
+        db_ = chronos.ChronosDB(archive_url=t["archive_url"])
+        # role split: first 3 sorted nodes are masters, rest slaves
+        assert db_.role_nodes(t, "mesos-master") == ["n1", "n2", "n3"]
+        assert db_.role_nodes(t, "mesos-slave") == ["n4"]
+        # setup runs on every node in parallel (the engine's shape —
+        # _await_ports doubles as the cross-node bring-up barrier)
+        from jepsen_tpu.util import real_pmap
+
+        real_pmap(lambda n: db_.setup(t, n), nodes)
+        try:
+            # every node's mesos answers /state with its role
+            for n, role in (("n1", "master"), ("n4", "slave")):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{cfg['mesos_ports'][n]}"
+                        "/state", timeout=2) as r:
+                    import json as _json
+
+                    assert _json.load(r)["role"] == role
+            # kill n1's zookeeper: n1's chronos 500s, n2 still serves
+            db_.stop_component(t, "n1", "zk")
+            deadline = time.monotonic() + 10
+            gated = False
+            while time.monotonic() < deadline:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{cfg['ports']['n1']}"
+                        "/scheduler/jobs", timeout=2)
+                except urllib.error.HTTPError as e:
+                    if e.code == 500:
+                        gated = True
+                        break
+                time.sleep(0.2)
+            assert gated, "chronos never noticed its zk died"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{cfg['ports']['n2']}"
+                    "/scheduler/jobs", timeout=2) as r:
+                assert r.status == 200
+            # revive: the ComponentKiller restart path
+            db_.start_component(t, "n1", "zk")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{cfg['ports']['n1']}"
+                            "/scheduler/jobs", timeout=2) as r:
+                        assert r.status == 200
+                        break
+                except urllib.error.HTTPError:
+                    time.sleep(0.2)
+            else:
+                raise AssertionError("n1 never recovered after zk revive")
+        finally:
+            for n in nodes:
+                db_.teardown(t, n)
