@@ -62,20 +62,28 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _tpu_usable(timeout: float = 45.0) -> bool:
+def _tpu_usable() -> bool:
     """Probe TPU/axon backend availability in a SUBPROCESS — if the
     tunnel is down, backend init hangs rather than failing, so the probe
-    must be killable."""
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            capture_output=True,
-            timeout=timeout,
-            text=True,
-        )
-        return p.returncode == 0 and "ok" in p.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    must be killable. A cold axon tunnel can take >45 s to come up
+    (VERDICT r4 item 1: the round-4 capture fell to CPU on a marginal
+    45 s single shot), so the probe RETRIES with growing budgets before
+    concluding the TPU is gone."""
+    for timeout in (60.0, 120.0, 180.0):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print('ok')"],
+                capture_output=True,
+                timeout=timeout,
+                text=True,
+            )
+            if p.returncode == 0 and "ok" in p.stdout:
+                return True
+            log(f"tpu probe failed (rc={p.returncode}); retrying")
+        except subprocess.TimeoutExpired:
+            log(f"tpu probe timed out at {timeout:.0f}s; retrying")
+    return False
 
 
 def _helpers():
@@ -130,9 +138,29 @@ def summarize(results, total_ops, elapsed) -> dict:
     }
 
 
+# Spread honesty (VERDICT r4 item 7): a lane whose rep-to-rep spread
+# exceeds SPREAD_BOUND is re-measured with fresh seeds; one that stays
+# above SPREAD_HARD after retries FAILS the bench — a capture that noisy
+# cannot distinguish a real regression from tunnel variance and must not
+# ship as evidence.
+SPREAD_BOUND = 1.5
+SPREAD_HARD = 3.0
+
+
 def main():
     use_tpu = _tpu_usable()
     if not use_tpu:
+        # NEVER silently downgrade the premise (VERDICT r4 weak 1: the
+        # round-4 artifact was an interpret-mode capture that exited 0
+        # and published emulation walls as pallas_ms). A CPU run must be
+        # explicitly requested, and it marks every artifact it touches.
+        if os.environ.get("BENCH_ALLOW_CPU") != "1":
+            log("FATAL: TPU backend unavailable after 3 probe attempts. "
+                "This bench measures TPU engines; a CPU-fallback capture "
+                "is not evidence. Set BENCH_ALLOW_CPU=1 to run anyway "
+                "(the artifact will be marked backend=cpu-fallback and "
+                "interpret=true throughout).")
+            sys.exit(2)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     import jax
@@ -181,30 +209,46 @@ def main():
             tpu_check.last_engine = "xla"
             return wgl_tpu.analysis_batch(m, lanes, **kw)
 
-    def timed_batch(m, build_fn, k=3, check=None, **kw):
+    def timed_batch(m, build_fn, k=3, check=None, _attempt=0, **kw):
         """Warm on a fixed-seed batch (a new lane-count/pad/model
         retraces; an identical batch would hit the tunnel's launch
         memoizer), then time k reps on FRESH-seeded same-shape batches
         and report the median with min-max spread — single-shot lanes
         cannot tell a real regression from tunnel variance (VERDICT r3
-        item 8). Returns (median-rep results, summary)."""
+        item 8). A lane whose spread exceeds SPREAD_BOUND re-measures
+        itself (fresh seeds — the rep offset keeps retry batches out of
+        the tunnel's launch memo) up to twice; a spread still beyond
+        SPREAD_HARD fails the bench (VERDICT r4 item 7: noisy lanes
+        must fail loudly, not ship as evidence). Returns (median-rep
+        results, summary)."""
         check = check or tpu_check
-        warm, _ = build_fn(-1)
-        check(m, warm, **kw)
+        if _attempt == 0:
+            warm, _ = build_fn(-1)
+            check(m, warm, **kw)
         reps = []
         for r in range(k):
-            lanes, n = build_fn(r)
+            lanes, n = build_fn(_attempt * 16 + r)
             t0 = time.monotonic()
             res = check(m, lanes, **kw)
             reps.append((time.monotonic() - t0, n, res))
         reps.sort(key=lambda t: t[0] / max(t[1], 1))
         wall, n, res = reps[len(reps) // 2]
         s = summarize(res, n, wall)
-        s["spread"] = {
-            "k": k,
-            "ops_per_s_min": round(min(nn / w for w, nn, _ in reps), 1),
-            "ops_per_s_max": round(max(nn / w for w, nn, _ in reps), 1),
-        }
+        lo = round(min(nn / w for w, nn, _ in reps), 1)
+        hi = round(max(nn / w for w, nn, _ in reps), 1)
+        s["spread"] = {"k": k, "ops_per_s_min": lo, "ops_per_s_max": hi,
+                       "ratio": round(hi / max(lo, 1e-9), 2)}
+        if s["spread"]["ratio"] > SPREAD_BOUND and _attempt < 2:
+            log(f"spread {s['spread']['ratio']}x > {SPREAD_BOUND} "
+                f"(attempt {_attempt}); re-measuring with fresh seeds")
+            return timed_batch(m, build_fn, k=k, check=check,
+                               _attempt=_attempt + 1, **kw)
+        assert s["spread"]["ratio"] <= SPREAD_HARD, (
+            f"lane spread {s['spread']['ratio']}x exceeds the hard bound "
+            f"{SPREAD_HARD}x after {_attempt + 1} attempts — this capture "
+            "cannot distinguish a regression from noise and must not ship")
+        if s["spread"]["ratio"] > SPREAD_BOUND:
+            s["noisy"] = True
         return res, s
 
     # ------------------------------------------------------------------
@@ -234,7 +278,9 @@ def main():
         seed = 7100 if rep < 0 else run_seed + 100 + 7919 * (rep + 1)
         return build_cas_lanes(1, 200, 3, seed=seed)
 
-    res, configs["etcd-cas-200"] = timed_batch(model, etcd_build)
+    # k=5: this lane's wall is ~100ms (round-trip-bound), where k=3
+    # medians still wander ~1.5x rep-to-rep (VERDICT r4 item 7)
+    res, configs["etcd-cas-200"] = timed_batch(model, etcd_build, k=5)
     assert all(r.valid is True for r in res), [r.valid for r in res]
     log(f"etcd-cas-200: {configs['etcd-cas-200']}")
 
@@ -435,10 +481,17 @@ def main():
     # on the axon backend, a multi-minute device launch can trip the
     # tunnel's op watchdog. Steps/s on the capped budget is the metric.
     def invalid_build(rep):
+        # 64 lanes (was 16): refutation cost varies a lot per seed, so a
+        # 16-lane rep's wall is dominated by its deepest draw — at 64
+        # lanes the per-rep maximum concentrates and the spread guard
+        # measures the ENGINE, not the input lottery (VERDICT r4 item 7)
         seed = 7600 if rep < 0 else run_seed + 600 + 7919 * (rep + 1)
-        return build_cas_lanes(16, 60, 5, seed=seed, corrupt=0.2)
+        return build_cas_lanes(64, 60, 5, seed=seed, corrupt=0.2)
 
-    res, configs["invalid-heavy"] = timed_batch(model, invalid_build,
+    # k=5: refutation walls vary with the (seeded) corruption pattern —
+    # the r4 artifact's 5.5x spread at k=3 is exactly what the spread
+    # guard + more reps are for (VERDICT r4 item 7)
+    res, configs["invalid-heavy"] = timed_batch(model, invalid_build, k=5,
                                                 max_steps=200_000)
     # decomposition (VERDICT r3 item 6): counterexamples now come OUT
     # of the kernel (deepest prefix + stuck entry tracked during the
@@ -554,6 +607,11 @@ def main():
             entry["pallas_ms"] = walls[len(walls) // 2]
             entry["pallas_ms_spread"] = [walls[0], walls[-1]]
             entry["pallas_steps"] = int(sum(r.steps for r in prs))
+            if not use_tpu:
+                # interpret-mode emulation walls are NOT pallas results
+                # and must say so (VERDICT r4 weak 1: the r4 artifact
+                # published 62x emulation walls unmarked)
+                entry["interpret"] = True
         except ValueError as e:
             entry["pallas_ms"] = None
             log(f"pallas lane skipped: {e}")
@@ -579,8 +637,28 @@ def main():
             pallas_kernel_resident_ms(4096, 128, 0.3, 4_000,
                                       seed=run_seed + 950))
     log(f"crossover deep-4096: {crossover['deep-4096']}")
+    # 8k/16k lanes (VERDICT r4 item 2): the shapes where the kernel's
+    # fixed dispatch+fetch round trip and the pipelined chunked pack
+    # (wgl_pallas_vec.CHUNK_BLOCKS) amortize past native's per-lane
+    # sequential cost — the measured end-to-end crossover. k=3: these
+    # rows are the round's headline claim and 2 reps can't carry a
+    # spread. Interpret mode would take hours; TPU only.
+    if use_tpu:
+        for n_keys in (8192, 16384):
+            crossover[f"deep-{n_keys}"] = backend_walls(
+                n_keys, 64, 0.3, 4_000, seed=run_seed + 900 + n_keys,
+                xla=False, k=3)
+            log(f"crossover deep-{n_keys}: "
+                f"{crossover[f'deep-{n_keys}']}")
     configs["tpu-vs-native"] = crossover
 
+    # Backend provenance on EVERY artifact level (VERDICT r4 item 1):
+    # the r4 capture's only backend marker lived in the metric string,
+    # which the driver's tail truncation ate. Top-level field + a field
+    # in each config survives any partial read.
+    for c in configs.values():
+        if isinstance(c, dict) and "backend" not in c:
+            c["backend"] = backend
     print(
         json.dumps(
             {
@@ -589,6 +667,7 @@ def main():
                 + backend + ")",
                 "value": round(north_star_ops_s, 1),
                 "unit": "ops/s",
+                "backend": backend,
                 "vs_baseline": round(60.0 / elapsed, 1),
                 "cold_compile_s": round(cold, 1),
                 "configs": configs,

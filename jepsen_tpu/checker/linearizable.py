@@ -97,13 +97,9 @@ def _pallas_eligible(model, entries_list) -> bool:
     except ImportError:
         return False
     jm = mjit.for_model(model)
-    if jm is None or not entries_list:
+    if jm is None:
         return False
-    n_pad = wgl_pallas_vec._pad_size(
-        max(len(es) for es in entries_list))
-    if not wgl_pallas_vec.eligible(jm, n_pad):
-        return False
-    return all(jm.lane_eligible(es) for es in entries_list)
+    return wgl_pallas_vec.batch_eligible(jm, entries_list)
 
 
 def _native_available(model, es) -> bool:

@@ -78,7 +78,23 @@ class JitModel:
         return encode_value
 
     def lane_eligible(self, es) -> bool:
-        """Every payload in `es` has an int32 encoding."""
+        """Every payload in `es` has an int32 encoding. Memoized on the
+        Entries instance: the checker probes eligibility once for
+        routing and the engines re-check before packing, and at
+        many-thousand-lane batch shapes the per-entry Python scan was
+        the single largest host cost (r5 profile: ~7 s of a 12 s
+        16k-lane check)."""
+        cached = getattr(es, "_lane_elig", None)
+        if cached is not None and cached[0] == self.name:
+            return cached[1]
+        ok = self._lane_eligible(es)
+        try:
+            es._lane_elig = (self.name, ok)
+        except AttributeError:  # not an Entries (e.g. a test stub)
+            pass
+        return ok
+
+    def _lane_eligible(self, es) -> bool:
         for f, v in zip(es.f, es.value_out):
             if f not in self.fs:
                 continue  # encoded as never-linearizable, value unused
@@ -165,7 +181,7 @@ class JitModel:
         firsts: list = []
 
         def kid(fn, val):
-            k = (fn, tuple(val)) if isinstance(val, list) else (fn, val)
+            k = (fn, tuple(val)) if type(val) is list else (fn, val)
             i = keymap.get(k)
             if i is None:
                 i = len(keymap)
@@ -184,7 +200,7 @@ class JitModel:
         enc = self.encode_entry
 
         def one(fn, val):
-            k = (fn, tuple(val)) if isinstance(val, list) else (fn, val)
+            k = (fn, tuple(val)) if type(val) is list else (fn, val)
             t = cache.get(k)
             if t is None:
                 t = enc(fn, val, encode_value)
@@ -289,11 +305,22 @@ class QueueJitModel:
     def _universe(self, es) -> dict:
         """value -> slot over every enqueue/dequeue payload in the lane
         (insertion order; dict equality collapses ==-equal values just
-        like the host model's multiset membership test)."""
+        like the host model's multiset membership test). Memoized on
+        the Entries instance: routing (batch_eligible), state sizing
+        (_state_pad -> lane_width) and packing (lane_codec) each need
+        it, and the dict walk is the queue family's dominant per-lane
+        host cost at many-thousand-lane batch shapes."""
+        cached = getattr(es, "_q_universe", None)
+        if cached is not None:
+            return cached
         m: dict = {}
         for f, v in zip(es.f, es.value_out):
             if f in self.fs and v not in m:
                 m[v] = len(m)
+        try:
+            es._q_universe = m
+        except AttributeError:  # not an Entries (e.g. a test stub)
+            pass
         return m
 
     def lane_width(self, es) -> int:
@@ -305,12 +332,23 @@ class QueueJitModel:
 
     def lane_eligible(self, es) -> bool:
         """Eligible iff every queue payload is hashable (unhashable
-        values can't index the slot map; the host path handles them)."""
+        values can't index the slot map; the host path handles them).
+        Memoized on the Entries instance like JitModel.lane_eligible —
+        the dict walk is the queue's per-lane pack cost and the checker
+        re-probes it for routing."""
+        cached = getattr(es, "_lane_elig", None)
+        if cached is not None and cached[0] == self.name:
+            return cached[1]
         try:
             self._universe(es)
+            ok = True
         except TypeError:
-            return False
-        return True
+            ok = False
+        try:
+            es._lane_elig = (self.name, ok)
+        except AttributeError:
+            pass
+        return ok
 
     def init_vec(self, width: int) -> np.ndarray:
         return np.zeros(width, np.int32)
@@ -458,7 +496,14 @@ def encode_value(v) -> int:
     integers are encodable — floats/strings would be silently truncated
     or coerced, letting the kernel accept histories the host model
     rejects, so they raise instead (the checker then uses the host
-    search)."""
+    search). The `type(v) is int` fast path matters: this runs per
+    payload per lane at pack time, and the numbers.Integral ABC
+    dispatch alone was ~2.5 s of a 16k-lane batch check (r5 profile)."""
+    if type(v) is int:
+        if -1073741824 < v < 1073741824:  # +-2**30
+            return v
+        raise OverflowError(
+            f"value {v} does not fit the int32 kernel encoding")
     if v is None:
         return int(NIL32)
     import numbers

@@ -49,11 +49,14 @@ densely (two-pass scheduling) so one deep lane can't hold 32 blocks
 at the full budget.
 
 Scope: scalar kernel models (cas-register / register / mutex — one
-int32 state, state_in_key) AND the unordered queue (count-vector
-state laid out as extra sublane rows per lane column; memo key is the
-bitset alone, backtracking is the exact inverse step), for histories
-up to MAX_PAD entries. The fifo queue and larger pads route to
-ops/wgl_tpu.py.
+int32 state, state_in_key), the unordered queue (count-vector state
+laid out as extra sublane rows per lane column; memo key is the
+bitset alone, backtracking is the exact inverse step), AND the fifo
+queue (ring rows per lane column with absolute cursors; dequeue
+zeroes its slot so the raw ring is canonical and rides the memo key
+directly — no per-lane roll needed), for histories up to MAX_PAD
+entries. Fifo lanes wider than FIFO_MAX_RING enqueues and larger
+pads route to ops/wgl_tpu.py.
 
 On non-TPU backends the kernel runs in pallas interpret mode (the CPU
 test suite uses this for parity); on TPU it compiles via Mosaic.
@@ -79,7 +82,18 @@ log = logging.getLogger("jepsen_tpu.ops.wgl_pallas_vec")
 LANES = 128                  # lanes per grid program (one vreg row)
 CACHE_SLOTS = 128            # exact-key cache rows (compared in full)
 MAX_PAD = 1024               # bitset words stay a small sublane block
+FIFO_MAX_RING = 64           # fifo ring rows ride the memo key, so the
+#                              cache footprint scales with ring size —
+#                              wider-queue lanes route to the XLA path
+CACHE_VMEM_BUDGET = 2 << 20  # bytes of VMEM the memo cache may claim
+#                              (fifo keys are wide; slots shrink to fit)
 PASS1_CAP = 512              # first-pass step budget (two-pass sched)
+CHUNK_BLOCKS = 64            # blocks per pipelined launch chunk: wider
+#                              single buffers pack superlinearly slower
+#                              (scattered column writes thrash cache)
+#                              and serialize pack behind the kernel;
+#                              64-block chunks overlap the two (r5:
+#                              16k deep lanes 2.0s -> 0.8s end-to-end)
 NIL16 = 32767                # NIL32's image in the 16-bit value packing
 
 
@@ -97,27 +111,51 @@ def _nw_pad(n_pad: int) -> int:
 
 
 def eligible(jm, n_pad: int) -> bool:
-    """Scalar one-word models, plus the unordered queue (vector count
-    state as extra sublane rows per lane column; its memo key is the
-    bitset alone and backtracking is an exact inverse step, so neither
-    a state snapshot stack nor state words in the cache are needed).
-    The fifo queue stays on the XLA path: its memo key needs the
-    canonicalized ring buffer, and a per-lane dynamic roll has no
-    cheap lane-vectorized form."""
+    """Scalar one-word models, plus both queue families (vector state
+    as extra sublane rows per lane column; backtracking is an exact
+    inverse step, so no state snapshot stack). The unordered queue's
+    memo key is the bitset alone; the fifo queue's appends its ring
+    rows — instead of the per-lane dynamic roll a canonicalized ring
+    would need (no cheap lane-vectorized form), dequeue ZEROES its
+    slot and cursors are bitset-determined, so the raw ring rows ARE
+    canonical. Fifo lanes additionally need a bounded ring
+    (FIFO_MAX_RING) — checked per batch by `batch_eligible` /
+    analysis_batch, since it depends on the lanes' enqueue counts."""
     if n_pad > MAX_PAD:
         return False
     if isinstance(jm, mjit.JitModel) and jm.state_in_key:
         return True
-    return getattr(jm, "name", "") == "unordered-queue"
+    return getattr(jm, "name", "") in ("unordered-queue", "fifo-queue")
+
+
+def batch_eligible(jm, entries_list) -> bool:
+    """Full routing probe for a concrete batch: model/pad eligibility
+    plus per-lane payload encodability plus the fifo ring bound."""
+    if not entries_list:
+        return False
+    n_pad = _pad_size(max(len(es) for es in entries_list))
+    if not eligible(jm, n_pad):
+        return False
+    if not all(jm.lane_eligible(es) for es in entries_list):
+        return False
+    if getattr(jm, "name", "") == "fifo-queue":
+        return _state_pad(jm, entries_list) - 8 <= FIFO_MAX_RING
+    return True
 
 
 def _state_pad(jm, entries_list) -> int:
-    """Padded state rows for a batch: 1 for scalar models, the max
+    """Padded state rows for a batch: 1 for scalar models; the max
     lane width padded to a power of two (>=8, the sublane tile) for
-    the unordered queue — bucketed so re-batches reuse kernels."""
+    the unordered queue; ring capacity (pow2-bucketed max enqueue
+    count) + 8 cursor rows for the fifo queue — bucketed so re-batches
+    reuse kernels."""
     if isinstance(jm, mjit.JitModel):
         return 1
     w = max((jm.lane_width(es) for es in entries_list), default=1)
+    if getattr(jm, "name", "") == "fifo-queue":
+        # lane_width counts n_enq + 2 cursor slots; the kernel keeps
+        # cursors in their own 8-row block past the ring
+        return max(8, _next_pow2(max(1, w - 2))) + 8
     return max(8, _next_pow2(w))
 
 
@@ -130,11 +168,25 @@ def _make_kernel(jm, n_pad: int, n_state: int,
     nw_pad = _nw_pad(n_pad)
     # plain Python ints — jnp values created outside the kernel would
     # be captured tracers, which pallas rejects
-    uq = not isinstance(jm, mjit.JitModel)   # unordered queue family
-    init_state_c = 0 if uq else int(jm.init_state)
-    # queue memo keys are the bitset alone (state is a function of
-    # WHICH ops linearized); scalar keys append the one state word
-    key_words = nw if uq else nw + 1
+    scalar = isinstance(jm, mjit.JitModel)
+    fifo = getattr(jm, "name", "") == "fifo-queue"
+    uq = not scalar and not fifo             # unordered queue family
+    init_state_c = int(jm.init_state) if scalar else 0
+    # fifo ring capacity: state rows are [0, S) ring slots (0 = empty,
+    # value id + 1 otherwise), row S the head cursor, row S+1 the tail
+    # cursor (absolute counts — S >= the lane's total enqueues, sized
+    # by _state_pad, so cursors never wrap and overflow is impossible)
+    S = n_state - 8 if fifo else 0
+    # memo keys: the unordered queue's multiset is a function of WHICH
+    # ops linearized, so its key is the bitset alone; scalar keys
+    # append the one state word; the fifo queue's ORDER depends on the
+    # path, so its key appends the ring rows — and because dequeue
+    # ZEROES its slot (with the inverse step restoring it), the raw
+    # ring is already canonical: for a fixed bitset the k-th linearized
+    # enqueue writes slot k and head/tail are bitset-determined, so
+    # equal (bitset, ring) <=> equal logical queue. Head/tail rows stay
+    # OUT of the key (derivable), stale slots never exist.
+    key_words = (nw + S) if fifo else (nw if uq else nw + 1)
     cache_mask_c = cache_slots - 1
 
     def kernel(f_ref, v1_ref, v2_ref, crashed_ref, call_ref, ret_ref,
@@ -192,15 +244,16 @@ def _make_kernel(jm, n_pad: int, n_state: int,
             x = (x ^ (x >> 15)) * i32(-2048144789)
             return x ^ (x >> 13)
 
-        if uq:
+        if uq or fifo:
             s_iota = jax.lax.broadcasted_iota(i32, (n_state, LANES), 0)
 
         init = (
             jnp.where(two_n > 0, i32(1), i32(0)),        # node
             # scalar models: one state word; unordered queue: count
-            # vector over the lane's value slots, one sublane row each
-            (jnp.zeros((n_state, LANES), i32) if uq
-             else jnp.full((1, LANES), init_state_c, i32)),
+            # vector over the lane's value slots, one sublane row each;
+            # fifo queue: ring rows + head/tail cursor rows, all zero
+            (jnp.full((1, LANES), init_state_c, i32) if scalar
+             else jnp.zeros((n_state, LANES), i32)),
             jnp.zeros((nw_pad, LANES), i32),             # lin bitset
             jnp.zeros((1, LANES), i32),                  # h: zobrist fold
             jnp.zeros((1, LANES), i32),                  # depth
@@ -266,6 +319,32 @@ def _make_kernel(jm, n_pad: int, n_state: int,
                 ok = is_enq | (is_deq & (cnt > 0))
                 new_state = state + jnp.where(
                     mask_slot, jnp.where(is_enq, 1, -1), 0)
+            elif fifo:
+                # fifo queue inline (FifoQueueJitModel semantics as a
+                # ring with absolute cursors): enqueue writes value+1
+                # at slot `tail`; dequeue is ok iff the queue is
+                # nonempty AND the head slot holds its value, then
+                # ZEROES the slot (keeping the ring canonical for the
+                # memo key) and advances head. NIL32/-1 f-codes make
+                # both branches false.
+                is_enq = f_e == 0
+                is_deq = f_e == 1
+                head = state[S:S + 1, :]                 # [1, L]
+                tail = state[S + 1:S + 2, :]
+                mask_head = s_iota == head               # [n_state, L]
+                mask_tail = s_iota == tail
+                front = jnp.sum(jnp.where(mask_head, state, 0),
+                                axis=0, keepdims=True)
+                enq_ok = is_enq & (tail < S)
+                deq_ok = is_deq & (head < tail) & (front == v1_e + 1)
+                ok = enq_ok | deq_ok
+                new_state = jnp.where(
+                    mask_tail & enq_ok, v1_e + 1,
+                    jnp.where(mask_head & deq_ok, 0,
+                              jnp.where(s_iota == S, head + deq_ok,
+                                        jnp.where(s_iota == S + 1,
+                                                  tail + enq_ok,
+                                                  state)))).astype(i32)
             else:
                 new_state, ok = jm.step(state, f_e, v1_e, v2_e)
                 new_state = new_state.astype(i32)
@@ -284,16 +363,29 @@ def _make_kernel(jm, n_pad: int, n_state: int,
             # key-folds both leave ~40-60% more step-capped unknowns
             # than the Zobrist fold at equal slots) ----
             new_h = h_lin ^ zmix(e)
-            hm = (new_h if uq else new_h ^ new_state) * i32(16777619)
+            if scalar:
+                hm = (new_h ^ new_state) * i32(16777619)
+            elif fifo:
+                # fold the stepped value into the slot choice: same
+                # bitset + different ring orders should prefer
+                # different slots (retention only — lookup is exact)
+                hm = (new_h ^ zmix(v1_e)) * i32(16777619)
+            else:
+                hm = new_h * i32(16777619)
             hm = hm ^ (hm >> 15)
             slot = hm & i32(cache_mask_c)                # [1, L]
             eq = cache_used[...] != 0                    # [C, L]
             for w in range(nw):
                 eq = eq & (cache[:, w * LANES:(w + 1) * LANES]
                            == new_lin[w:w + 1, :])
-            if not uq:  # queue keys are the bitset alone
+            if scalar:  # unordered-queue keys are the bitset alone
                 eq = eq & (cache[:, nw * LANES:(nw + 1) * LANES]
                            == new_state)
+            elif fifo:  # ring rows complete the key (order matters)
+                for j in range(S):
+                    eq = eq & (
+                        cache[:, (nw + j) * LANES:(nw + j + 1) * LANES]
+                        == new_state[j:j + 1, :])
             found = jnp.max(eq.astype(i32), axis=0, keepdims=True) != 0
 
             do_lift = can_lin & ~found
@@ -308,6 +400,24 @@ def _make_kernel(jm, n_pad: int, n_state: int,
                 mask_slot2 = s_iota == v1_e2
                 pop_state = state + jnp.where(
                     mask_slot2, jnp.where(f_e2 == 0, -1, 1), 0)
+            elif fifo:
+                # exact inverse step: un-enqueue zeroes slot tail-1 and
+                # retreats tail; un-dequeue restores the entry's value
+                # at head-1 (the zeroed slot) and retreats head
+                v1_e2 = pick(mask_e2, v1_ref)
+                f_e2 = pick(mask_e2, f_ref)
+                undo_enq = f_e2 == 0
+                undo_deq = f_e2 == 1
+                head = state[S:S + 1, :]
+                tail = state[S + 1:S + 2, :]
+                pop_state = jnp.where(
+                    (s_iota == tail - 1) & undo_enq, 0,
+                    jnp.where((s_iota == head - 1) & undo_deq,
+                              v1_e2 + 1,
+                              jnp.where(s_iota == S, head - undo_deq,
+                                        jnp.where(s_iota == S + 1,
+                                                  tail - undo_enq,
+                                                  state)))).astype(i32)
             else:
                 pop_state = pick(mask_d, stack_s)
             word2 = e2 // 32
@@ -399,15 +509,22 @@ def _make_kernel(jm, n_pad: int, n_state: int,
                 cache[:, w * LANES:(w + 1) * LANES] = jnp.where(
                     sl, new_lin[w:w + 1, :],
                     cache[:, w * LANES:(w + 1) * LANES])
-            if not uq:
+            if scalar:
                 cache[:, nw * LANES:(nw + 1) * LANES] = jnp.where(
                     sl, new_state,
                     cache[:, nw * LANES:(nw + 1) * LANES])
+            elif fifo:
+                for j in range(S):
+                    cache[:, (nw + j) * LANES:(nw + j + 1) * LANES] = \
+                        jnp.where(
+                            sl, new_state[j:j + 1, :],
+                            cache[:, (nw + j) * LANES:(nw + j + 1)
+                                  * LANES])
             cache_used[...] = jnp.where(sl, i32(1), cache_used[...])
 
             push = (n_iota == depth) & do_lift
             stack_e[...] = jnp.where(push, e, stack_e[...])
-            if not uq:  # the queue backtracks by inverse step instead
+            if scalar:  # the queues backtrack by inverse step instead
                 stack_s[...] = jnp.where(push, state, stack_s[...])
 
             # ---- next scalars ----
@@ -447,42 +564,18 @@ def _make_kernel(jm, n_pad: int, n_state: int,
     return kernel, m_pad
 
 
-def _pack(entries_list, jm, n_pad: int,
-          v16: bool | None = None) -> tuple[dict, int]:
-    """Pack lanes column-wise into the FEWEST bit-packed int32 rows.
-    Only genuine per-entry facts cross the host->device boundary; the
-    node->entry map and the initial linked list are derived in-kernel
-    from the call/ret rows, and both payload values pack into one
-    16-bit-halved row whenever they fit (NIL32 -> the NIL16 sentinel).
-    The tunnel moves ~4MB/s (raw) to ~9MB/s (compressible), so every
-    dropped row is milliseconds: this layout is 2n+1 rows vs r3's
-    3n+m+1 — ~2.6x fewer bytes at the deep-4096 bench shape.
+def _encode_flats(entries_list, jm, n_pad: int) -> dict:
+    """Encode a whole batch ONCE into flat per-entry fact arrays.
 
-    Padding lanes have n_completed == 0, so they go VALID at init and
-    idle through the block's loop. Padded ENTRIES aim their call/ret
-    positions at the trash row m_pad-1: m_pad >= 2*n_pad+2 (the +1 is
-    odd, the tile is 8), so the trash row is outside every reachable
-    node id and the kernel's node->entry reduction never matches it.
-
-    Row blocks, all int32:
-      [0:n)   meta: (f+1) | crashed<<3 | cp<<4 | rp<<16
-              (f+1 fits 3 bits, cp/rp fit 12 — m_pad <= 2*1024+8)
-      [n:2n)  (v1_16 & 0xFFFF) | v2_16<<16   when every value fits
-              int16 (NIL32 encodes as NIL16); otherwise two separate
-              int32 rows [n:2n) v1, [2n:3n) v2 — the launcher picks
-              the unpack by row count
-      [-1]    n | n_completed<<16
-    """
+    Splitting encode from layout lets the chunked launch pipeline and
+    the two-pass survivor relaunch re-LAYOUT arbitrary lane subsets by
+    pure numpy gathers instead of re-running the per-entry Python
+    encoders (r5 profile: encoding was ~3 s of a 12 s 16k-lane check,
+    and re-encoding survivors doubled it)."""
     m_pad = _m_pad(n_pad)
     n_lanes = len(entries_list)
-    # block counts bucket to powers of two so re-batches (the two-pass
-    # scheduler's survivor pass) reuse compiled kernels instead of
-    # paying a fresh pallas trace per exact width
-    n_blocks = (n_lanes + LANES - 1) // LANES
-    n_blocks = 1 if n_blocks <= 1 else _next_pow2(n_blocks)
-    width = n_blocks * LANES
-
     ns = np.array([len(es) for es in entries_list], np.int64)
+    offs = np.concatenate([[0], np.cumsum(ns)])
     total = int(ns.sum())
     f_flat = v1_flat = v2_flat = None
     if isinstance(jm, mjit.JitModel):
@@ -516,7 +609,6 @@ def _pack(entries_list, jm, n_pad: int,
                if nonempty else np.zeros(0, np.int64)).astype(np.int32) + 1
 
     lane_idx = np.repeat(np.arange(n_lanes), ns)
-    row_idx = np.arange(total) - np.repeat(np.cumsum(ns) - ns, ns)
 
     # Duplicate call/ret positions would silently corrupt the kernel's
     # node->entry sum-reduction (two matching entries would ADD).
@@ -532,17 +624,83 @@ def _pack(entries_list, jm, n_pad: int,
     # 16-bit value packing: NIL32 remaps to NIL16; anything else must
     # fit int16 below the sentinel. Histories with wider payloads fall
     # back to two full int32 value rows (same kernel, fatter transfer).
-    # Callers that relaunch a SUBSET of a packed batch (the two-pass
-    # scheduler) pin v16 to the first pack's decision: a flipped row
-    # count would retrace the launcher's jit — a ~1s Mosaic compile —
-    # mid-check, which dwarfs the bytes saved. Pinning True is safe
-    # only for subsets (a superset that fit keeps fitting).
+    # The decision is made ONCE over the whole batch, so every chunk
+    # and the two-pass survivor relaunch share one layout (a flipped
+    # row count would retrace the launcher's jit — a ~1s Mosaic
+    # compile — mid-check).
     nil1 = v1_flat == mjit.NIL32
     nil2 = v2_flat == mjit.NIL32
+    v16_fit = bool(
+        np.all(nil1 | ((v1_flat >= -32768) & (v1_flat < NIL16)))
+        and np.all(nil2 | ((v2_flat >= -32768) & (v2_flat < NIL16))))
+
+    return {
+        "f": f_flat, "v1": v1_flat, "v2": v2_flat,
+        "cr": cr_flat.astype(np.int32), "cp": cp_flat, "rp": rp_flat,
+        "ns": ns, "offs": offs, "v16_fit": v16_fit,
+        "ncomp": np.array([es.n_completed for es in entries_list],
+                          np.int32),
+    }
+
+
+def _layout(flats: dict, idx, n_pad: int,
+            v16: bool | None = None) -> tuple[np.ndarray, int]:
+    """Lay the lanes `idx` (None = all) out column-wise into the FEWEST
+    bit-packed int32 rows. Only genuine per-entry facts cross the
+    host->device boundary; the node->entry map and the initial linked
+    list are derived in-kernel from the call/ret rows, and both payload
+    values pack into one 16-bit-halved row whenever they fit (NIL32 ->
+    the NIL16 sentinel). The tunnel moves ~4MB/s (raw) to ~9MB/s
+    (compressible), so every dropped row is milliseconds: this layout
+    is 2n+1 rows vs r3's 3n+m+1 — ~2.6x fewer bytes at the deep-4096
+    bench shape.
+
+    Padding lanes have n_completed == 0, so they go VALID at init and
+    idle through the block's loop. Padded ENTRIES aim their call/ret
+    positions at the trash row m_pad-1: m_pad >= 2*n_pad+2 (the +1 is
+    odd, the tile is 8), so the trash row is outside every reachable
+    node id and the kernel's node->entry reduction never matches it.
+
+    Row blocks, all int32:
+      [0:n)   meta: (f+1) | crashed<<3 | cp<<4 | rp<<16
+              (f+1 fits 3 bits, cp/rp fit 12 — m_pad <= 2*1024+8)
+      [n:2n)  (v1_16 & 0xFFFF) | v2_16<<16   when every value fits
+              int16 (NIL32 encodes as NIL16); otherwise two separate
+              int32 rows [n:2n) v1, [2n:3n) v2 — the launcher picks
+              the unpack by row count
+      [-1]    n | n_completed<<16
+    """
+    m_pad = _m_pad(n_pad)
+    ns_all, offs = flats["ns"], flats["offs"]
+    if idx is None:
+        ns = ns_all
+        sel = slice(None)
+    else:
+        idx = np.asarray(idx, np.int64)
+        ns = ns_all[idx]
+        total_sel = int(ns.sum())
+        cum = np.cumsum(ns) - ns
+        sel = (np.repeat(offs[idx] - cum, ns)
+               + np.arange(total_sel, dtype=np.int64))
+    n_lanes = len(ns)
+    # block counts bucket to powers of two so re-batches (the two-pass
+    # scheduler's survivor pass) reuse compiled kernels instead of
+    # paying a fresh pallas trace per exact width
+    n_blocks = (n_lanes + LANES - 1) // LANES
+    n_blocks = 1 if n_blocks <= 1 else _next_pow2(n_blocks)
+    width = n_blocks * LANES
+
+    f_flat, v1_flat, v2_flat = (
+        flats["f"][sel], flats["v1"][sel], flats["v2"][sel])
+    cr_flat, cp_flat, rp_flat = (
+        flats["cr"][sel], flats["cp"][sel], flats["rp"][sel])
+    ncomp = flats["ncomp"] if idx is None else flats["ncomp"][idx]
     if v16 is None:
-        v16 = bool(
-            np.all(nil1 | ((v1_flat >= -32768) & (v1_flat < NIL16)))
-            and np.all(nil2 | ((v2_flat >= -32768) & (v2_flat < NIL16))))
+        v16 = flats["v16_fit"]
+
+    total = len(f_flat)
+    lane_idx = np.repeat(np.arange(n_lanes), ns)
+    row_idx = np.arange(total) - np.repeat(np.cumsum(ns) - ns, ns)
 
     rows = (2 if v16 else 3) * n_pad + 1
     buf = np.zeros((rows, width), np.int32)
@@ -555,6 +713,8 @@ def _pack(entries_list, jm, n_pad: int,
     f2d[row_idx, lane_idx] = f_flat
     cr2d[row_idx, lane_idx] = cr_flat
     buf[0:n_pad] = (f2d + 1) | (cr2d << 3) | (cp2d << 4) | (rp2d << 16)
+    nil1 = v1_flat == mjit.NIL32
+    nil2 = v2_flat == mjit.NIL32
     if v16:
         vv = buf[n_pad:2 * n_pad]
         vv.fill(NIL16 | (NIL16 << 16))  # padding entries: both NIL
@@ -569,9 +729,16 @@ def _pack(entries_list, jm, n_pad: int,
         v1[row_idx, lane_idx] = v1_flat
         v2[row_idx, lane_idx] = v2_flat
 
-    ncomp = np.array([es.n_completed for es in entries_list], np.int32)
     buf[-1, :n_lanes] = ns.astype(np.int32) | (ncomp << 16)
     return buf, n_blocks
+
+
+def _pack(entries_list, jm, n_pad: int,
+          v16: bool | None = None) -> tuple[np.ndarray, int]:
+    """Encode + lay out a whole batch (see _encode_flats/_layout —
+    split so chunked launches re-layout subsets without re-encoding)."""
+    flats = _encode_flats(entries_list, jm, n_pad)
+    return _layout(flats, None, n_pad, v16)
 
 
 _kernel_cache: dict = {}
@@ -591,8 +758,10 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
     if key in _kernel_cache:
         return _kernel_cache[key]
 
-    uq = not isinstance(jm, mjit.JitModel)
-    key_words = _nw(n_pad) if uq else _nw(n_pad) + 1
+    scalar = isinstance(jm, mjit.JitModel)
+    fifo = getattr(jm, "name", "") == "fifo-queue"
+    key_words = (_nw(n_pad) + (n_state - 8) if fifo
+                 else _nw(n_pad) + 1 if scalar else _nw(n_pad))
     kernel, m_pad = _make_kernel(jm, n_pad, n_state, cache_slots)
     nw = _nw(n_pad)
 
@@ -620,9 +789,9 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
             pltpu.VMEM((m_pad, LANES), jnp.int32),   # nxt
             pltpu.VMEM((m_pad, LANES), jnp.int32),   # prv
             pltpu.VMEM((n_pad, LANES), jnp.int32),   # stack_e
-            # stack_s is untouched for the queue (inverse-step
+            # stack_s is untouched for the queues (inverse-step
             # backtracking); keep a token row so the arity is fixed
-            pltpu.VMEM((8 if uq else n_pad, LANES), jnp.int32),
+            pltpu.VMEM((n_pad if scalar else 8, LANES), jnp.int32),
             pltpu.VMEM((cache_slots, key_words * LANES), jnp.int32),
             pltpu.VMEM((cache_slots, LANES), jnp.int32),
         ],
@@ -696,36 +865,80 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
             raise ValueError("lane has no int32 encoding")
 
     n_state = _state_pad(jm, entries_list)
+    cache_slots = CACHE_SLOTS
+    if getattr(jm, "name", "") == "fifo-queue":
+        ring = n_state - 8
+        if ring > FIFO_MAX_RING:
+            raise ValueError(
+                f"fifo ring {ring} > {FIFO_MAX_RING}: memo keys would "
+                "overflow the VMEM cache budget — use the XLA path")
+        # ring rows ride every cache slot; shrink the slot count so the
+        # cache stays within its VMEM budget
+        key_bytes = (_nw(n_pad) + ring) * LANES * 4
+        cache_slots = max(8, min(
+            CACHE_SLOTS, _next_pow2(CACHE_VMEM_BUDGET // key_bytes + 1)
+            // 2))
+    flats = _encode_flats(entries_list, jm, n_pad)
+    n = len(entries_list)
 
-    v16_cell: list = []  # pin the pass-1 layout for the survivor pass
+    def launch(idx, cap):
+        """Launch the lanes `idx` (None = all) at step cap `cap`.
 
-    def launch(sub_entries, cap):
-        packed, n_blocks = _pack(
-            sub_entries, jm, n_pad,
-            v16=v16_cell[0] if v16_cell else None)
-        if not v16_cell:
-            v16_cell.append(packed.shape[0] == 2 * n_pad + 1)
-        run = _launcher(jm, n_pad, interpret, n_blocks, n_state)
-        msteps = np.full((1, n_blocks * LANES), cap, np.int32)
-        small_dev, best_dev = run(packed, msteps)
-        # numpy fetch of the small block is the completion sync
-        # (block_until_ready does not reliably block for pallas results
-        # on the tunnel backend); the best-stack array STAYS on device
-        # and is fetched lazily — only a refuted lane ever reads it.
-        # When the verdicts show refutations, the fetch starts
-        # ASYNCHRONOUSLY here so it streams while the host builds the
-        # valid lanes' results.
-        small = np.asarray(small_dev)
-        if (small[0] == INVALID).any():
-            try:
-                best_dev.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                pass
+        Batches wider than CHUNK_BLOCKS blocks split into chunks,
+        each packed and DISPATCHED before the first is fetched: jax
+        dispatch is async, so chunk i's kernel overlaps chunk i+1's
+        host-side layout, and the layout itself is superlinear in
+        buffer width (cache-thrashing scattered column writes — r5
+        measured a 16k-lane pack at 1.5 s in one 128-block buffer vs
+        ~0.5 s as two 64-block chunks, and end-to-end 2.0 s -> 0.8 s).
+
+        Returns (small, best): small is the fetched (5, n_sel) verdict
+        block; best() lazily fetches the counterexample stacks."""
+        if idx is None and n <= CHUNK_BLOCKS * LANES:
+            chunk_idx: list = [None]
+        else:
+            base = np.arange(n, dtype=np.int64) if idx is None \
+                else np.asarray(idx, np.int64)
+            step = CHUNK_BLOCKS * LANES
+            if interpret or len(base) <= step:
+                chunk_idx = [base]
+            else:
+                chunk_idx = [base[i:i + step]
+                             for i in range(0, len(base), step)]
+        handles = []
+        for ch in chunk_idx:
+            packed, n_blocks = _layout(flats, ch, n_pad)
+            run = _launcher(jm, n_pad, interpret, n_blocks, n_state,
+                            cache_slots)
+            msteps = np.full((1, n_blocks * LANES), cap, np.int32)
+            w = n if ch is None else len(ch)
+            handles.append((run(packed, msteps), w))
+        smalls, bests = [], []
+        for (small_dev, best_dev), w in handles:
+            # numpy fetch of the small block is the completion sync
+            # (block_until_ready does not reliably block for pallas
+            # results on the tunnel backend); the best-stack array
+            # STAYS on device and is fetched lazily — only a refuted
+            # lane ever reads it. When the verdicts show refutations,
+            # the fetch starts ASYNCHRONOUSLY here so it streams while
+            # the host builds the valid lanes' results.
+            small = np.asarray(small_dev)[:, :w]
+            if (small[0] == INVALID).any():
+                try:
+                    best_dev.copy_to_host_async()
+                except (AttributeError, NotImplementedError):
+                    pass
+            smalls.append(small)
+            bests.append((best_dev, w))
+        small = (smalls[0] if len(smalls) == 1
+                 else np.concatenate(smalls, axis=1))
         cell: list = []
 
         def best():
             if not cell:
-                cell.append(np.asarray(best_dev))
+                parts = [np.asarray(bd)[:, :w] for bd, w in bests]
+                cell.append(parts[0] if len(parts) == 1
+                            else np.concatenate(parts, axis=1))
             return cell[0]
 
         return small, best
@@ -758,8 +971,7 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
     two_pass = (max_steps > 8 * PASS1_CAP
                 and len(entries_list) > LANES)
     pass1_cap = min(PASS1_CAP, max_steps) if two_pass else max_steps
-    small1, best1 = launch(entries_list, pass1_cap)
-    n = len(entries_list)
+    small1, best1 = launch(None, pass1_cap)
     survivors = [i for i in range(n) if small1[0][i] == UNKNOWN]
     surv_set = set(survivors)
     results: list = [None] * n
@@ -767,8 +979,7 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         if i not in surv_set:
             results[i] = result(es, small1, best1, i)
     if survivors and max_steps > pass1_cap:
-        small2, best2 = launch(
-            [entries_list[i] for i in survivors], max_steps)
+        small2, best2 = launch(survivors, max_steps)
         for j, i in enumerate(survivors):
             # pass-1 work is genuinely spent: report it in the total
             results[i] = result(entries_list[i], small2, best2, j,

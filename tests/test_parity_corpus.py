@@ -224,10 +224,8 @@ class TestPallasVecParity:
                 # interpret mode costs milliseconds PER LOCKSTEP
                 # ITERATION — only shallow searches are affordable
                 continue
-            n_pad = max(32, 1 << (len(es) - 1).bit_length())
-            if not wgl_pallas_vec.eligible(jm, n_pad) \
-                    or not jm.lane_eligible(es):
-                continue
+            if not wgl_pallas_vec.batch_eligible(jm, [es]):
+                continue  # incl. fifo lanes beyond FIFO_MAX_RING
             by_model.setdefault(case["model"], []).append((case, es))
 
         assert by_model, "no pallas-eligible corpus cases?"

@@ -83,14 +83,54 @@ class TestLiteralHistories:
         hist = random_register_history(n_process=4, n_ops=30, seed=9)
         assert one(CASRegister(), hist, max_steps=1).valid == "unknown"
 
-    def test_fifo_queue_rejected(self):
+    def test_fifo_queue_literals(self):
         from jepsen_tpu.models import FIFOQueue
 
-        with pytest.raises(ValueError, match="ineligible"):
-            wgl_pallas_vec.analysis_batch(
-                FIFOQueue(),
-                [make_entries(h(invoke_op(0, "enqueue", 1),
-                                ok_op(0, "enqueue", 1)))])
+        m = FIFOQueue()
+        good = h(
+            invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+            invoke_op(0, "enqueue", "b"), ok_op(0, "enqueue", "b"),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", "a"),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", "b"),
+        )
+        assert one(m, good).valid is True
+        # out-of-order dequeue: unordered-valid but FIFO-invalid
+        bad = h(
+            invoke_op(0, "enqueue", "a"), ok_op(0, "enqueue", "a"),
+            invoke_op(0, "enqueue", "b"), ok_op(0, "enqueue", "b"),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", "b"),
+            invoke_op(1, "dequeue"), ok_op(1, "dequeue", "a"),
+        )
+        assert one(m, bad).valid is False
+        # CONCURRENT enqueues may linearize either way round
+        race = h(
+            invoke_op(0, "enqueue", "a"),
+            invoke_op(1, "enqueue", "b"),
+            ok_op(0, "enqueue", "a"), ok_op(1, "enqueue", "b"),
+            invoke_op(2, "dequeue"), ok_op(2, "dequeue", "b"),
+            invoke_op(2, "dequeue"), ok_op(2, "dequeue", "a"),
+        )
+        assert one(m, race).valid is True
+        # a crashed dequeue with no observed value can never linearize
+        # but is optional — the history stays valid without it
+        crashy = h(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue"), info_op(1, "dequeue"),
+            invoke_op(2, "dequeue"), ok_op(2, "dequeue", 1),
+        )
+        assert one(m, crashy).valid is True
+
+    def test_fifo_queue_wide_ring_rejected(self):
+        """Lanes whose enqueue count exceeds FIFO_MAX_RING must route
+        away (their ring rows would blow the VMEM memo budget)."""
+        from jepsen_tpu.models import FIFOQueue
+
+        ops = []
+        for i in range(wgl_pallas_vec.FIFO_MAX_RING + 1):
+            ops += [invoke_op(0, "enqueue", i), ok_op(0, "enqueue", i)]
+        with pytest.raises(ValueError, match="fifo ring"):
+            wgl_pallas_vec.analysis_batch(FIFOQueue(),
+                                          [make_entries(h(*ops))])
 
     def test_unordered_queue_literals(self):
         m = UnorderedQueue()
