@@ -585,11 +585,15 @@ def main():
             return sorted(walls), out
 
         if have_native:
-            walls, _ = reps(lambda lanes: [
+            walls, rns = reps(lambda lanes: [
                 wgl_native.analysis(model, es, max_steps=max_steps)
                 for es in lanes])
             entry["native_ms"] = walls[len(walls) // 2]
             entry["native_ms_spread"] = [walls[0], walls[-1]]
+            # native's unbounded-memo step count is the yardstick for
+            # the pallas kernel's bounded-cache re-exploration
+            # (VERDICT r4 item 3): steps_ratio = pallas_steps / this
+            entry["native_steps"] = int(sum(r.steps for r in rns))
         if xla:
             walls, _ = reps(
                 lambda lanes: wgl_tpu.analysis_batch(
@@ -607,6 +611,12 @@ def main():
             entry["pallas_ms"] = walls[len(walls) // 2]
             entry["pallas_ms_spread"] = [walls[0], walls[-1]]
             entry["pallas_steps"] = int(sum(r.steps for r in prs))
+            if entry.get("native_steps"):
+                # both counts come from each backend's LAST rep, and
+                # reps() seeds every backend identically per rep — so
+                # this is an exact same-input ratio, not an estimate
+                entry["steps_ratio"] = round(
+                    entry["pallas_steps"] / entry["native_steps"], 2)
             if not use_tpu:
                 # interpret-mode emulation walls are NOT pallas results
                 # and must say so (VERDICT r4 weak 1: the r4 artifact

@@ -1,0 +1,31 @@
+#!/usr/bin/env sh
+# CI lane for the real-sshd contract tests (VERDICT r4 item 9 /
+# SURVEY §4.1 live-cluster tier): the build/judge image ships no
+# OpenSSH at all, so tests/test_control_sshd.py skips there by design.
+# This script is the recorded environment where they EXECUTE: it
+# builds the control image (python + openssh-server) and runs exactly
+# that file inside it, appending the outcome to docker/CI_SSHD_LOG so
+# the repo carries evidence of the last real-OpenSSH run.
+#
+# Usage (any docker host):   sh docker/ci-sshd.sh
+set -eu
+cd "$(dirname "$0")/.."
+
+docker build -t jepsen-control docker/control
+# status comes from pytest's EXIT CODE, not summary-line parsing — a
+# mixed "1 failed, 2 passed" line must never read as a pass
+if full=$(docker run --rm -v "$PWD":/jepsen_tpu jepsen-control \
+    python -m pytest /jepsen_tpu/tests/test_control_sshd.py -q 2>&1)
+then status=PASS; else status=FAIL; fi
+out=$(printf '%s\n' "$full" | tail -3)
+echo "$out"
+case "$out" in
+  *skipped*) status="$status (SKIPS PRESENT — sshd missing in image?)" ;;
+esac
+{
+  echo "## $(date -u +%Y-%m-%dT%H:%M:%SZ) — $status"
+  echo '```'
+  echo "$out"
+  echo '```'
+} >> docker/CI_SSHD_LOG.md
+[ "$status" = PASS ]

@@ -73,20 +73,19 @@ TRUNCATE = 10
 # resolves in well under a millisecond) and then finishes the
 # unresolved tail with the full budget. The pallas lane kernel beats
 # native kernel-resident (~80M steps/s across 128 lanes vs ~10M
-# single-thread), but on this tunnel-attached host the fixed
-# dispatch+fetch round trip (~110ms) and the tunnel's ~4-11MB/s
-# transfer rate set an end-to-end floor native undercuts — even after
-# r4 cut the transfer to per-entry facts only (node maps and the
-# linked list are derived in-kernel, values 16-bit-packed, the
-# counterexample stack fetched lazily as int16), the deep-4096 gap
-# only closed from ~2.4x to ~1.2x and did not invert; shallow shapes
-# are round-trip-bound outright. So with a working C++ toolchain
-# native wins end-to-end at every measured shape ON THIS HOST; on
-# PCIe-attached TPU hardware the same decomposition favors the
-# kernel. Auto escalates to pallas only when native is UNAVAILABLE
-# (e.g. a TPU VM without a compiler), where it beats the pure-Python
-# host search by >10x on batches.
+# single-thread), but the tunnel-attached host's fixed dispatch+fetch
+# round trip (~110ms) sets an end-to-end floor native undercuts at
+# SMALL shapes (34-1024 lanes are round-trip-bound outright;
+# deep-4096 native still leads ~540 vs ~620ms). The r5 chunked
+# pipelined launches moved the crossover onto this host: deep-8192 is
+# parity and deep-16384 the pallas engine WINS end-to-end (~1.0s vs
+# ~1.4s, non-overlapping spreads — BENCH r5 tpu-vs-native). So auto
+# escalates a hard tail to pallas either when native is UNAVAILABLE
+# (e.g. a TPU VM without a compiler; pallas beats the pure-Python
+# fallback >10x) or when the tail is at least PALLAS_BATCH_MIN lanes
+# — the measured shape where the kernel beats the C++ engine outright.
 TRIAGE_MAX_STEPS = 2_000
+PALLAS_BATCH_MIN = 8192
 
 
 def _pallas_eligible(model, entries_list) -> bool:
@@ -169,17 +168,19 @@ class Linearizable(Checker):
         es = make_entries(history)
         algorithm = self.algorithm
         if algorithm == "auto":
-            # P-compositional fast path: an unordered-queue history
-            # decomposes by value into micro-lanes (ops/pcomp.py) —
-            # the exponential interleaving search collapses into a
-            # batch of trivial ones.
+            # P-compositional fast path: a product-model history
+            # (unordered queue by value, single-key-txn multi-register
+            # by key — the Model.components hook decides, ops/pcomp.py)
+            # decomposes into micro-lanes and the exponential
+            # interleaving search collapses into a batch of trivial
+            # ones.
             from ..ops import pcomp
 
             if pcomp.eligible(model):
-                lanes = pcomp.split(es)
+                lanes = pcomp.split(model, es)
                 if lanes is not None:
-                    rs = self._auto_results(
-                        model, lanes, self._steps_budget(),
+                    rs = self._component_results(
+                        lanes, self._steps_budget(),
                         deadline=self._deadline())
                     d = self._result(_combine_lanes(rs))
                     self._render_invalid(test, history, d, opts)
@@ -270,10 +271,11 @@ class Linearizable(Checker):
                 results[i] = self.check(test, h, o)
             return results
 
-        # P-compositional preprocessing: unordered-queue histories
-        # decompose by value into micro-lanes (ops/pcomp.py); the
-        # whole batch's lanes flatten into ONE engine pass and each
-        # item's verdict recombines from its own lanes.
+        # P-compositional preprocessing: product-model histories
+        # decompose into micro-lanes via the Model.components hook
+        # (ops/pcomp.py); the whole batch's lanes flatten into ONE
+        # engine pass per distinct sub-model and each item's verdict
+        # recombines from its own lanes.
         from ..ops import pcomp
 
         if pcomp.eligible(model):
@@ -281,15 +283,15 @@ class Linearizable(Checker):
             spans: list = []
             ok = True
             for es in ess:
-                lanes = pcomp.split(es)
+                lanes = pcomp.split(model, es)
                 if lanes is None:
                     ok = False
                     break
                 spans.append((len(flat), len(flat) + len(lanes)))
                 flat.extend(lanes)
             if ok:
-                rs = self._auto_results(model, flat, batch_kw,
-                                        deadline=self._deadline())
+                rs = self._component_results(flat, batch_kw,
+                                             deadline=self._deadline())
                 for i, (a, b) in enumerate(spans):
                     finish(i, _combine_lanes(rs[a:b]))
                 return results
@@ -318,6 +320,25 @@ class Linearizable(Checker):
 
         return (None if self.time_limit is None
                 else _t.monotonic() + self.time_limit)
+
+    def _component_results(self, comp_lanes, batch_kw,
+                           deadline: float | None = None) -> list:
+        """WGLResults for a flat list of (sub_model, Entries) component
+        lanes (pcomp.split output), batched per DISTINCT sub-model —
+        the engines take one model per batch call. Queue components
+        share one UnorderedQueue; a multi-register split yields one
+        Register per distinct initial value (usually just one)."""
+        out: list = [None] * len(comp_lanes)
+        groups: dict = {}
+        for i, (m, _es) in enumerate(comp_lanes):
+            groups.setdefault(m, []).append(i)
+        for m, idxs in groups.items():
+            rs = self._auto_results(
+                m, [comp_lanes[i][1] for i in idxs], batch_kw,
+                deadline=deadline)
+            for i, r in zip(idxs, rs):
+                out[i] = r
+        return out
 
     def _auto_results(self, model, ess, batch_kw,
                       deadline: float | None = None) -> list:
@@ -370,15 +391,29 @@ class Linearizable(Checker):
                 return self.time_limit
             return max(0.001, deadline - _t.monotonic())
 
+        hard = [i for i in pending if native_ok[i]]
         rest = [i for i in pending if not native_ok[i]]
+        pallas_ok = None  # remembered when it covers `rest` exactly —
+        #                   the probe is O(total ops), don't pay twice
+        if (len(hard) >= PALLAS_BATCH_MIN
+                and _pallas_eligible(model, [ess[i] for i in hard + rest])):
+            # a hard tail this wide is the measured shape where the
+            # pallas engine beats the C++ engine END-TO-END (BENCH r5
+            # deep-16384; rationale at PALLAS_BATCH_MIN) — escalate it
+            # even though native could finish it
+            rest = hard + rest
+            hard = []
+            pallas_ok = True
         for i, r in native_map(
-                [i for i in pending if native_ok[i]],
+                hard,
                 lambda i: wgl_native.analysis(
                     model, ess[i], time_limit=lane_limit())):
             out[i] = r
         if rest:
             sub = [ess[i] for i in rest]
-            if _pallas_eligible(model, sub):
+            if pallas_ok is None:
+                pallas_ok = _pallas_eligible(model, sub)
+            if pallas_ok:
                 from ..ops import wgl_pallas_vec
 
                 for i, r in zip(rest,

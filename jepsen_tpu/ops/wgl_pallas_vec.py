@@ -500,10 +500,20 @@ def _make_kernel(jm, n_pad: int, n_state: int,
             # abandoned branches' shallow entries squat in slots, and
             # growing capacity loses outright: the no-dynamic-indexing
             # lookup is O(slots), so C=1024 cut steps 17.8M -> 6.9M
-            # but wall ROSE 593ms -> 1521ms. The bounded-vs-unbounded
-            # memo gap vs native (~6-7x steps on exhaustive deep
-            # batches) is structural to lane-vectorized VMEM search,
-            # not a tuning miss. ----
+            # but wall ROSE 593ms -> 1521ms (r4); RE-MEASURED after the
+            # r5 chunked-launch refactor (same shape, v5e): C=128
+            # 730-750ms/16-17M steps, C=256 800-815ms/12-13M, C=512
+            # 910-920ms/9-9.5M vs native 326ms/2.7M — capacity still
+            # buys steps at a worse wall. SURVEY §7.1's HBM-resident
+            # open-addressed table does not map to Mosaic: a per-lane
+            # random slot needs a per-lane dynamic gather/scatter,
+            # which the no-dynamic-lane-indexing model cannot express,
+            # and per-step HBM round trips would cost ~100x the ~38ns
+            # resident step. The bounded-vs-unbounded memo gap vs
+            # native (~6x steps on exhaustive deep batches; ~1.4x at
+            # the step-capped deep-4096 bench shape, `steps_ratio` in
+            # the artifact) is structural to lane-vectorized VMEM
+            # search, not a tuning miss. ----
             sl = (c_iota == slot) & do_lift              # [C, L]
             for w in range(nw):
                 cache[:, w * LANES:(w + 1) * LANES] = jnp.where(
@@ -745,16 +755,25 @@ _kernel_cache: dict = {}
 
 
 def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
-              n_state: int = 1, cache_slots: int = CACHE_SLOTS):
+              n_state: int = 1, cache_slots: int = CACHE_SLOTS,
+              mesh=None):
     """One jitted pallas_call per (model, shape, blocks, cache) —
     building the call is ~1 s of host tracing, dwarfing the sub-ms
     kernel, so it must happen once, not per invocation. The step
     budget is a runtime input, so every cap shares one compiled
-    kernel."""
+    kernel.
+
+    With a `mesh` (one "blocks" axis), the launch shard_maps over it:
+    blocks are independent by construction, so each device runs
+    n_blocks/mesh.size grid programs over its own column shard and the
+    only cross-device traffic is the sharded result fetch — the same
+    deal-the-lanes scaling story as wgl_tpu's mesh path
+    (wgl_tpu.py:677-707), now for the flagship engine."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    key = (jm.name, n_pad, interpret, n_blocks, n_state, cache_slots)
+    key = (jm.name, n_pad, interpret, n_blocks, n_state, cache_slots,
+           mesh)
     if key in _kernel_cache:
         return _kernel_cache[key]
 
@@ -773,7 +792,12 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
         spec(n_pad), spec(n_pad),
         spec(1), spec(1), spec(1),
     ]
-    width = n_blocks * LANES
+    # under a mesh each device runs its share of the (independent)
+    # blocks; the pallas grid and result width are per-shard
+    n_dev = mesh.size if mesh is not None else 1
+    assert n_blocks % n_dev == 0, (n_blocks, n_dev)
+    blocks_local = n_blocks // n_dev
+    width = blocks_local * LANES
     out_specs = [spec(1)] * 5 + [spec(n_pad)]
     out_shape = (
         [jax.ShapeDtypeStruct((1, width), jnp.int32)] * 5
@@ -781,7 +805,7 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
     )
     call = pl.pallas_call(
         kernel,
-        grid=(n_blocks,),
+        grid=(blocks_local,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
@@ -798,8 +822,7 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
         interpret=interpret,
     )
 
-    @jax.jit
-    def run(buf, msteps):
+    def body(buf, msteps):
         # unpack the single bit-packed transfer buffer (layout in
         # _pack; the row count says whether values are 16-bit-packed)
         # — all fused into the dispatch
@@ -835,16 +858,47 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
             [verdict, steps, depth, bestd, stuck], axis=0)
         return small, beststack.astype(jnp.int16)
 
+    if mesh is None:
+        run = jax.jit(body)
+    else:
+        from jax.sharding import PartitionSpec as P
+        shard_map = jax.shard_map
+
+        # every input/output row block is columnwise-independent, so
+        # sharding the width axis is exact; replication checking off —
+        # pallas calls don't carry replication info (the kwarg was
+        # renamed check_rep -> check_vma in jax 0.8)
+        try:
+            sharded = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(None, "blocks"), P(None, "blocks")),
+                out_specs=(P(None, "blocks"), P(None, "blocks")),
+                check_vma=False)
+        except TypeError:
+            sharded = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(None, "blocks"), P(None, "blocks")),
+                out_specs=(P(None, "blocks"), P(None, "blocks")),
+                check_rep=False)
+        run = jax.jit(sharded)
+
     _kernel_cache[key] = run
     return run
 
 
 def analysis_batch(model, entries_list, max_steps: int | None = None,
-                   interpret: bool | None = None) -> list:
+                   interpret: bool | None = None,
+                   devices=None) -> list:
     """Check a batch of independent histories, 128 lanes per kernel
     program. Raises on ineligible models/sizes — callers probe with
     `eligible` first (checker/linearizable routes here for scalar
-    models; everything else uses ops/wgl_tpu)."""
+    models; everything else uses ops/wgl_tpu).
+
+    `devices`: >1 jax devices shard the batch's 128-lane blocks over a
+    1-D "blocks" mesh via shard_map — each device searches its own
+    share (blocks are independent), the production multi-chip path for
+    the flagship engine. The driver's dryrun exercises it on a virtual
+    CPU mesh (__graft_entry__.dryrun_multichip)."""
     jm = mjit.for_model(model)
     if jm is None:
         raise ValueError(f"no kernel model for {model!r}")
@@ -856,6 +910,11 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         max_steps = DEFAULT_MAX_STEPS
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    mesh = None
+    if devices is not None and len(devices) > 1:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(devices), ("blocks",))
     n_pad = _pad_size(max(len(es) for es in entries_list))
     if not eligible(jm, n_pad):
         raise ValueError(
@@ -894,13 +953,17 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
 
         Returns (small, best): small is the fetched (5, n_sel) verdict
         block; best() lazily fetches the counterexample stacks."""
-        if idx is None and n <= CHUNK_BLOCKS * LANES:
+        if idx is None and (mesh is not None
+                            or n <= CHUNK_BLOCKS * LANES):
             chunk_idx: list = [None]
         else:
             base = np.arange(n, dtype=np.int64) if idx is None \
                 else np.asarray(idx, np.int64)
             step = CHUNK_BLOCKS * LANES
-            if interpret or len(base) <= step:
+            if mesh is not None or interpret or len(base) <= step:
+                # a mesh launch stays single-shot: the mesh itself is
+                # the parallelism, and per-chunk launches would leave
+                # devices idle between dispatches
                 chunk_idx = [base]
             else:
                 chunk_idx = [base[i:i + step]
@@ -908,8 +971,15 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         handles = []
         for ch in chunk_idx:
             packed, n_blocks = _layout(flats, ch, n_pad)
+            if mesh is not None and n_blocks % mesh.size:
+                # pad with empty-lane columns (n = ncomp = 0: VALID at
+                # init, idle) so every device gets whole blocks
+                pad_to = -(-n_blocks // mesh.size) * mesh.size
+                packed = np.pad(
+                    packed, ((0, 0), (0, (pad_to - n_blocks) * LANES)))
+                n_blocks = pad_to
             run = _launcher(jm, n_pad, interpret, n_blocks, n_state,
-                            cache_slots)
+                            cache_slots, mesh)
             msteps = np.full((1, n_blocks * LANES), cap, np.int32)
             w = n if ch is None else len(ch)
             handles.append((run(packed, msteps), w))
@@ -966,8 +1036,14 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
     # hundreds of steps); survivors are repacked DENSELY so only their
     # few blocks pay the deep budget. Only worth the second dispatch's
     # fixed round trip (~110ms) when the full budget dwarfs the pass-1
-    # cap and there is more than one block to densify (measured: at a
-    # 4k cap two-pass LOSES ~15%, at 200k it halves the wall).
+    # cap and there is more than one block to densify. Re-measured
+    # after the r5 chunked-launch refactor (VERDICT r4 item 8), fresh
+    # seeds, k=2, on the v5e: scattered-hard 1024 lanes at a 200k cap
+    # 632-680ms two-pass vs 932-990ms single (-32%); all-valid 1024
+    # lanes at 2M indistinguishable (survivors=0 skips pass 2); and at
+    # deep-4096/16384's 4k cap FORCING it on loses 25-40% — which the
+    # `8 *` threshold already excludes (4000 < 8*512: the gate is OFF
+    # there by design, not by accident).
     two_pass = (max_steps > 8 * PASS1_CAP
                 and len(entries_list) > LANES)
     pass1_cap = min(PASS1_CAP, max_steps) if two_pass else max_steps

@@ -293,3 +293,71 @@ class TestReviewRegressions:
         finally:
             wh.analysis = orig_w
             ln.analysis = orig_l
+
+
+class TestAutoPallasEscalation:
+    """The r5 batched-auto policy: a hard tail of at least
+    PALLAS_BATCH_MIN pallas-eligible lanes escalates to the pallas
+    engine even when the native toolchain exists (the measured
+    end-to-end crossover, BENCH r5 deep-16384). Thresholds are scaled
+    down so the policy runs at test size."""
+
+    def test_wide_hard_tail_escalates_to_pallas(self, monkeypatch):
+        from helpers import random_register_history
+
+        import importlib
+
+        lin_mod = importlib.import_module(
+            "jepsen_tpu.checker.linearizable")
+        from jepsen_tpu.ops import wgl_host, wgl_pallas_vec
+
+        # every lane survives triage (1-step budget) -> all "hard"
+        monkeypatch.setattr(lin_mod, "TRIAGE_MAX_STEPS", 1)
+        monkeypatch.setattr(lin_mod, "PALLAS_BATCH_MIN", 4)
+        from jepsen_tpu.history import entries as make_entries
+
+        calls = []
+        real = wgl_pallas_vec.analysis_batch
+
+        def spy(model, ess, **kw):
+            calls.append(len(ess))
+            return real(model, ess, **kw)
+
+        monkeypatch.setattr(wgl_pallas_vec, "analysis_batch", spy)
+        m = CASRegister()
+        hists = [random_register_history(
+            n_process=3, n_ops=10, seed=8600 + s,
+            corrupt=0.4 if s % 3 == 0 else 0.0) for s in range(8)]
+        chk = checker.linearizable(m)
+        rs = chk.check_batch({"model": m}, [(h, {}) for h in hists])
+        assert calls and calls[0] == 8, calls
+        for h, r in zip(hists, rs):
+            want = wgl_host.analysis(m, make_entries(h)).valid
+            assert r["valid"] == want
+
+    def test_narrow_hard_tail_stays_native(self, monkeypatch):
+        from helpers import random_register_history
+
+        import importlib
+
+        lin_mod = importlib.import_module(
+            "jepsen_tpu.checker.linearizable")
+        from jepsen_tpu.ops import wgl_native, wgl_pallas_vec
+
+        try:
+            wgl_native._get_lib()
+        except Exception:
+            pytest.skip("no native toolchain")
+        monkeypatch.setattr(lin_mod, "TRIAGE_MAX_STEPS", 1)
+
+        def boom(model, ess, **kw):
+            raise AssertionError("pallas must not run below the bar")
+
+        monkeypatch.setattr(wgl_pallas_vec, "analysis_batch", boom)
+        m = CASRegister()
+        hists = [random_register_history(n_process=3, n_ops=10,
+                                         seed=8700 + s)
+                 for s in range(4)]  # < PALLAS_BATCH_MIN
+        chk = checker.linearizable(m)
+        rs = chk.check_batch({"model": m}, [(h, {}) for h in hists])
+        assert all(r["valid"] is True for r in rs)

@@ -30,23 +30,24 @@ class TestSplit:
             invoke_op(1, "enqueue", "b"), ok_op(1, "enqueue", "b"),
             invoke_op(0, "dequeue"), ok_op(0, "dequeue", "a"),
         ))
-        lanes = pcomp.split(es)
-        assert sorted(len(l) for l in lanes) == [1, 2]
+        lanes = pcomp.split(UnorderedQueue(), es)
+        assert sorted(len(l) for _m, l in lanes) == [1, 2]
+        assert all(isinstance(m, UnorderedQueue) for m, _l in lanes)
 
     def test_crashed_valueless_dequeue_drops(self):
         es = make_entries(h(
             invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
             invoke_op(1, "dequeue"), info_op(1, "dequeue"),
         ))
-        lanes = pcomp.split(es)
-        assert len(lanes) == 1 and len(lanes[0]) == 1
+        lanes = pcomp.split(UnorderedQueue(), es)
+        assert len(lanes) == 1 and len(lanes[0][1]) == 1
 
     def test_crashed_enqueue_projects(self):
         es = make_entries(h(
             invoke_op(0, "enqueue", 1), info_op(0, "enqueue", 1),
             invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
         ))
-        (lane,) = pcomp.split(es)
+        ((_m, lane),) = pcomp.split(UnorderedQueue(), es)
         assert len(lane) == 2
 
     def test_unhashable_payload_bails(self):
@@ -54,11 +55,15 @@ class TestSplit:
             invoke_op(0, "enqueue", {"k": 1}),
             ok_op(0, "enqueue", {"k": 1}),
         ))
-        assert pcomp.split(es) is None
+        assert pcomp.split(UnorderedQueue(), es) is None
 
-    def test_fifo_not_eligible(self):
-        assert not pcomp.eligible(FIFOQueue())
+    def test_eligibility_is_hook_based(self):
+        from jepsen_tpu.models import MultiRegister, Register
+
+        assert not pcomp.eligible(FIFOQueue())     # no components hook
+        assert not pcomp.eligible(Register())
         assert pcomp.eligible(UnorderedQueue())
+        assert pcomp.eligible(MultiRegister())
 
     def test_precedence_preserved_in_projection(self):
         """Two same-value ops strictly ordered in real time must stay
@@ -134,6 +139,160 @@ class TestAdversarialLiterals:
             invoke_op(1, "dequeue"), ok_op(1, "dequeue", 5),
         )
         assert self._both(hist) is True
+
+
+def _mr_txn(p, micros, kind="ok"):
+    """invoke+completion pair for one multi-register txn."""
+    mk = {"ok": ok_op, "info": info_op}[kind]
+    return [invoke_op(p, "txn", micros), mk(p, "txn", micros)]
+
+
+class TestMultiRegister:
+    """The second decomposing family (VERDICT r4 item 6): single-key
+    txn histories split by key into plain Register lanes."""
+
+    def _model(self):
+        from jepsen_tpu.models import MultiRegister
+
+        return MultiRegister()
+
+    def test_split_by_key_rewrites_to_register_ops(self):
+        from jepsen_tpu.models import Register
+
+        es = make_entries(h(
+            *_mr_txn(0, [["w", "x", 1]]),
+            *_mr_txn(1, [["w", "y", 2]]),
+            *_mr_txn(0, [["r", "x", 1]]),
+        ))
+        lanes = pcomp.split(self._model(), es)
+        assert sorted(len(l) for _m, l in lanes) == [1, 2]
+        assert all(m == Register() for m, _l in lanes)
+        (x_lane,) = [l for _m, l in lanes if len(l) == 2]
+        assert x_lane.f == ["write", "read"]
+        assert x_lane.value_out == [1, 1]
+
+    def test_multi_micro_txn_does_not_decompose(self):
+        es = make_entries(h(
+            *_mr_txn(0, [["w", "x", 1], ["w", "y", 2]]),
+        ))
+        assert pcomp.split(self._model(), es) is None
+
+    def test_malformed_txn_payload_is_invalid_not_a_crash(self):
+        """A non-sequence txn payload must neither crash components
+        (decomposition returns None) nor the full search (step returns
+        Inconsistent) — review regression."""
+        from jepsen_tpu.models import Inconsistent
+
+        m = self._model()
+        assert isinstance(m.step("txn", 5), Inconsistent)
+        hist = h(invoke_op(0, "txn", 5), ok_op(0, "txn", 5))
+        es = make_entries(hist)
+        assert pcomp.split(m, es) is None
+        r = checker_mod.linearizable(m).check({}, hist, {})
+        assert r["valid"] is False
+
+    def test_mixed_type_register_keys(self):
+        """Unorderable key mixes must not crash state freezing in the
+        undecomposed search — review regression (multi-micro txns are
+        exactly the ones that skip decomposition)."""
+        m = self._model()
+        hist = h(
+            *_mr_txn(0, [["w", "x", 1], ["w", 2, 5]]),
+            *_mr_txn(1, [["r", "x", 1], ["r", 2, 5]]),
+        )
+        r = checker_mod.linearizable(m).check({}, hist, {})
+        assert r["valid"] is True
+
+    def test_crashed_unknown_txn_drops(self):
+        es = make_entries(h(
+            *_mr_txn(0, [["w", "x", 1]]),
+            invoke_op(1, "txn", None), info_op(1, "txn"),
+        ))
+        lanes = pcomp.split(self._model(), es)
+        assert len(lanes) == 1 and len(lanes[0][1]) == 1
+
+    def test_checker_verdicts(self):
+        m = self._model()
+        good = h(
+            *_mr_txn(0, [["w", "x", 1]]),
+            *_mr_txn(1, [["w", "y", 9]]),
+            *_mr_txn(0, [["r", "x", 1]]),
+            *_mr_txn(1, [["r", "y", 9]]),
+        )
+        assert checker_mod.linearizable(m).check({}, good, {})[
+            "valid"] is True
+        bad = h(
+            *_mr_txn(0, [["w", "x", 1]]),
+            *_mr_txn(0, [["r", "x", 2]]),
+        )
+        r = checker_mod.linearizable(m).check({}, bad, {})
+        assert r["valid"] is False
+        assert r.get("op") is not None
+        # a cross-key read anomaly must NOT be masked: y never written
+        bad2 = h(
+            *_mr_txn(0, [["w", "x", 5]]),
+            *_mr_txn(1, [["r", "y", 5]]),
+        )
+        assert checker_mod.linearizable(m).check({}, bad2, {})[
+            "valid"] is False
+
+    def test_crashed_write_is_optional(self):
+        m = self._model()
+        maybe = h(
+            *_mr_txn(0, [["w", "x", 3]], kind="info"),
+            *_mr_txn(1, [["r", "x", 3]]),
+        )
+        assert checker_mod.linearizable(m).check({}, maybe, {})[
+            "valid"] is True
+        unread = h(
+            *_mr_txn(0, [["w", "x", 3]], kind="info"),
+            *_mr_txn(1, [["r", "x", None]]),
+        )
+        assert checker_mod.linearizable(m).check({}, unread, {})[
+            "valid"] is True
+
+    def test_initial_values_flow_to_components(self):
+        from jepsen_tpu.models import MultiRegister
+
+        m = MultiRegister(registers=(("x", 7),))
+        good = h(*_mr_txn(0, [["r", "x", 7]]))
+        assert checker_mod.linearizable(m).check({}, good, {})[
+            "valid"] is True
+        bad = h(*_mr_txn(0, [["r", "x", 8]]))
+        assert checker_mod.linearizable(m).check({}, bad, {})[
+            "valid"] is False
+
+    def test_randomized_vs_undecomposed_host(self):
+        """Verdict equivalence vs the full (undecomposed) host search
+        on random single-key-txn histories — the same pinning pattern
+        as the queue family."""
+        import random
+
+        m = self._model()
+        chk = checker_mod.linearizable(m)  # auto: decomposes
+        for s in range(30):
+            rng = random.Random(5200 + s)
+            regs = {}
+            ops = []
+            for i in range(14):
+                p = i % 3
+                k = rng.choice("xyz")
+                if rng.random() < 0.5:
+                    v = rng.randrange(4)
+                    kind = "info" if rng.random() < 0.15 else "ok"
+                    ops += _mr_txn(p, [["w", k, v]], kind=kind)
+                    if kind == "ok":
+                        regs[k] = v
+                else:
+                    # mostly-true reads with occasional corruption
+                    v = regs.get(k)
+                    if v is not None and rng.random() < 0.2:
+                        v = v + 1
+                    ops += _mr_txn(p, [["r", k, v]])
+            hist = h(*ops)
+            want = wgl_host.analysis(m, make_entries(hist)).valid
+            got = chk.check({}, hist, {})["valid"]
+            assert got == want, (s, got, want)
 
 
 class TestVerdictEquivalence:

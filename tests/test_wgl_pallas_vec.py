@@ -335,3 +335,57 @@ class TestInKernelCounterexample:
         for op in r.best_linearization:
             state = state.step_op(op)
             assert not inconsistent(state), op
+
+
+class TestMeshSharding:
+    """The multi-device path: blocks shard_mapped over a 1-D "blocks"
+    mesh (conftest forces an 8-device virtual CPU backend). Verdicts,
+    steps and counterexamples must be identical to the single-device
+    launch — the mesh only deals blocks out."""
+
+    def test_mesh_parity_with_single_device(self):
+        import jax
+
+        devices = jax.devices()
+        assert len(devices) >= 8, "conftest should force 8 CPU devices"
+        m = CASRegister()
+        ess = [make_entries(random_register_history(
+            n_process=3, n_ops=10, seed=7300 + s,
+            corrupt=0.3 if s % 4 == 0 else 0.0))
+            for s in range(300)]  # 3 blocks -> padded to 8 over mesh
+        single = wgl_pallas_vec.analysis_batch(m, ess)
+        mesh = wgl_pallas_vec.analysis_batch(m, ess, devices=devices)
+        assert [r.valid for r in mesh] == [r.valid for r in single]
+        assert [r.steps for r in mesh] == [r.steps for r in single]
+        n_false = 0
+        for rm, rs in zip(mesh, single):
+            if rm.valid is False:
+                n_false += 1
+                assert (rm.op is None) == (rs.op is None)
+                if rm.op is not None:
+                    assert rm.op.index == rs.op.index
+        assert n_false >= 3
+
+    def test_mesh_queue_model(self):
+        import jax
+
+        from helpers import random_queue_history
+
+        m = UnorderedQueue()
+        ess = [make_entries(random_queue_history(
+            n_process=3, n_ops=10, seed=7600 + s)) for s in range(20)]
+        single = wgl_pallas_vec.analysis_batch(m, ess)
+        mesh = wgl_pallas_vec.analysis_batch(m, ess,
+                                             devices=jax.devices())
+        assert [r.valid for r in mesh] == [r.valid for r in single]
+
+    def test_single_device_list_is_not_a_mesh(self):
+        import jax
+
+        m = CASRegister()
+        ess = [make_entries(random_register_history(
+            n_process=3, n_ops=8, seed=7900))]
+        (r,) = wgl_pallas_vec.analysis_batch(
+            m, ess, devices=jax.devices()[:1])
+        (want,) = wgl_pallas_vec.analysis_batch(m, ess)
+        assert r.valid == want.valid
