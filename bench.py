@@ -361,6 +361,7 @@ def main():
             "k": 3,
             "ops_per_s_min": round(n_ops / walls[-1], 1),
             "ops_per_s_max": round(n_ops / walls[0], 1),
+            "ratio": round(walls[-1] / max(walls[0], 1e-9), 2),
         },
     }
 
@@ -410,6 +411,8 @@ def main():
         assert res_q["valid"] is True, res_q["valid"]
     qreps.sort(key=lambda t: t[0] / t[1])
     wall_q, n_q = qreps[len(qreps) // 2]
+    _q_lo = round(min(nn / w for w, nn in qreps), 1)
+    _q_hi = round(max(nn / w for w, nn in qreps), 1)
     configs["queue-10k-single-pcomp"] = {
         "ops": n_q,
         "wall_s": round(wall_q, 3),
@@ -417,8 +420,9 @@ def main():
         "verdicts": {"true": 1, "false": 0, "unknown": 0},
         "spread": {
             "k": 3,
-            "ops_per_s_min": round(min(nn / w for w, nn in qreps), 1),
-            "ops_per_s_max": round(max(nn / w for w, nn in qreps), 1),
+            "ops_per_s_min": _q_lo,
+            "ops_per_s_max": _q_hi,
+            "ratio": round(_q_hi / max(_q_lo, 1e-9), 2),
         },
     }
     log(f"queue-10k-single-pcomp: {configs['queue-10k-single-pcomp']}")
@@ -518,13 +522,14 @@ def main():
     # per-key-shaped lanes checked by (a) the native C++ engine,
     # sequentially, (b) the XLA while-loop kernel, (c) the pallas
     # lane-vectorized kernel. Valid lanes at 34/256/1024 (shallow
-    # searches: the reference's ~128-op per-key shape) plus a 4096-lane
-    # refutation-heavy batch. After the r4 transfer overhaul the
-    # pallas end-to-end gap at deep-4096 is ~1.1-1.3x (spreads
-    # overlap; best pallas reps beat best native reps) with the
-    # kernel-resident decomposition showing the remaining loss is
-    # entirely the tunnel's ~4-11MB/s + ~110ms round trips, not the
-    # search itself.
+    # searches: the reference's ~128-op per-key shape) plus
+    # refutation-heavy batches at 4096/8192/16384 lanes. After the r5
+    # chunked pipelined launches the pallas engine WINS end-to-end at
+    # the 8192/16384 shapes (16384: ~1.0s vs native ~1.4s,
+    # non-overlapping spreads) and trades the lead with native at
+    # 4096; the kernel-resident decomposition shows the kernel itself
+    # is ~4-6x faster than native resident — what remains at small
+    # shapes is the tunnel's ~110ms round trip, not the search.
     from jepsen_tpu.ops import wgl_pallas_vec
 
     def pallas_kernel_resident_ms(n_keys, ops_per_key, corrupt,

@@ -9,8 +9,9 @@ Backends:
                 encoding (models/jit.py) and payloads that fit int32.
   "pallas"      ops/wgl_pallas_vec.py — the whole search as ONE Mosaic
                 kernel, 128 lanes vectorized per program. Scalar
-                models only; the fastest batch engine by far (the
-                measured crossover lives in bench.py's
+                models plus both queue families; the fastest batch
+                engine by far and the end-to-end winner at >=8k-lane
+                shapes (the measured crossover lives in bench.py's
                 tpu-vs-native lanes).
   "linear"      ops/linear.py — just-in-time linearization over
                 configurations (knossos.linear analog): a genuinely
@@ -86,6 +87,19 @@ TRUNCATE = 10
 # — the measured shape where the kernel beats the C++ engine outright.
 TRIAGE_MAX_STEPS = 2_000
 PALLAS_BATCH_MIN = 8192
+
+
+def _tpu_backend() -> bool:
+    """Is the default jax backend a REAL TPU? The PALLAS_BATCH_MIN
+    escalation was measured on hardware; on a CPU-only host the pallas
+    engine runs interpret-mode emulation, which must never preempt the
+    C++ engine."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — no jax / no backend
+        return False
 
 
 def _pallas_eligible(model, entries_list) -> bool:
@@ -396,6 +410,7 @@ class Linearizable(Checker):
         pallas_ok = None  # remembered when it covers `rest` exactly —
         #                   the probe is O(total ops), don't pay twice
         if (len(hard) >= PALLAS_BATCH_MIN
+                and _tpu_backend()
                 and _pallas_eligible(model, [ess[i] for i in hard + rest])):
             # a hard tail this wide is the measured shape where the
             # pallas engine beats the C++ engine END-TO-END (BENCH r5

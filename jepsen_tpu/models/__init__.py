@@ -293,12 +293,19 @@ class MultiRegister(Model):
                 return None
 
         def rewrite(f, value):
-            if value is None or len(value) != 1:
-                # a crashed entry's completion payload can be unknown
-                # even when its invoke payload was kept — project it as
-                # an unobserved read (never constrains the register)
+            # guards mirror step()'s: the hook validated value_OUT, but
+            # rewrite also sees value_IN, and a malformed invoke payload
+            # paired with a well-formed completion must degrade to an
+            # unconstraining read, not crash the projection (the
+            # completed-op convention means the search only ever steps
+            # the value_out side)
+            if (not isinstance(value, (list, tuple))
+                    or len(value) != 1):
                 return "read", None
-            mf, _k, val = value[0]
+            try:
+                mf, _k, val = value[0]
+            except (TypeError, ValueError):
+                return "read", None
             return (("write", val) if mf in ("w", "write")
                     else ("read", val))
 

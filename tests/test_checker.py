@@ -311,9 +311,12 @@ class TestAutoPallasEscalation:
             "jepsen_tpu.checker.linearizable")
         from jepsen_tpu.ops import wgl_host, wgl_pallas_vec
 
-        # every lane survives triage (1-step budget) -> all "hard"
+        # every lane survives triage (1-step budget) -> all "hard";
+        # the escalation is hardware-gated (interpret-mode emulation
+        # must never preempt native), so fake a TPU backend here
         monkeypatch.setattr(lin_mod, "TRIAGE_MAX_STEPS", 1)
         monkeypatch.setattr(lin_mod, "PALLAS_BATCH_MIN", 4)
+        monkeypatch.setattr(lin_mod, "_tpu_backend", lambda: True)
         from jepsen_tpu.history import entries as make_entries
 
         calls = []

@@ -191,6 +191,19 @@ class TestMultiRegister:
         r = checker_mod.linearizable(m).check({}, hist, {})
         assert r["valid"] is False
 
+    def test_malformed_invoke_payload_with_good_completion(self):
+        """components() validates value_out; the rewrite also sees
+        value_IN, and a malformed invoke payload paired with a valid
+        completion must project (as an unconstraining read), not crash
+        — review regression."""
+        hist = h(
+            invoke_op(0, "txn", 5),
+            ok_op(0, "txn", [["w", "x", 1]]),
+            *_mr_txn(1, [["r", "x", 1]]),
+        )
+        r = checker_mod.linearizable(self._model()).check({}, hist, {})
+        assert r["valid"] is True
+
     def test_mixed_type_register_keys(self):
         """Unorderable key mixes must not crash state freezing in the
         undecomposed search — review regression (multi-micro txns are
