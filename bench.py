@@ -147,6 +147,15 @@ SPREAD_BOUND = 1.5
 SPREAD_HARD = 3.0
 
 
+def spread_dict(lo: float, hi: float, k: int) -> dict:
+    """The per-lane spread block: min/max ops/s across reps plus their
+    ratio (every lane reports it; timed_batch also guards on it).
+    Rounding lives here so every lane reports the same precision."""
+    lo, hi = round(lo, 1), round(hi, 1)
+    return {"k": k, "ops_per_s_min": lo, "ops_per_s_max": hi,
+            "ratio": round(hi / max(lo, 1e-9), 2)}
+
+
 def main():
     use_tpu = _tpu_usable()
     if not use_tpu:
@@ -234,10 +243,9 @@ def main():
         reps.sort(key=lambda t: t[0] / max(t[1], 1))
         wall, n, res = reps[len(reps) // 2]
         s = summarize(res, n, wall)
-        lo = round(min(nn / w for w, nn, _ in reps), 1)
-        hi = round(max(nn / w for w, nn, _ in reps), 1)
-        s["spread"] = {"k": k, "ops_per_s_min": lo, "ops_per_s_max": hi,
-                       "ratio": round(hi / max(lo, 1e-9), 2)}
+        s["spread"] = spread_dict(
+            min(nn / w for w, nn, _ in reps),
+            max(nn / w for w, nn, _ in reps), k)
         if s["spread"]["ratio"] > SPREAD_BOUND and _attempt < 2:
             log(f"spread {s['spread']['ratio']}x > {SPREAD_BOUND} "
                 f"(attempt {_attempt}); re-measuring with fresh seeds")
@@ -357,12 +365,7 @@ def main():
         "wall_s": round(wall, 3),
         "ops_per_s": round(n_ops / wall, 1),
         "verdicts": {"true": 2, "false": 0, "unknown": 0},
-        "spread": {
-            "k": 3,
-            "ops_per_s_min": round(n_ops / walls[-1], 1),
-            "ops_per_s_max": round(n_ops / walls[0], 1),
-            "ratio": round(walls[-1] / max(walls[0], 1e-9), 2),
-        },
+        "spread": spread_dict(n_ops / walls[-1], n_ops / walls[0], 3),
     }
 
     # ------------------------------------------------------------------
@@ -411,19 +414,13 @@ def main():
         assert res_q["valid"] is True, res_q["valid"]
     qreps.sort(key=lambda t: t[0] / t[1])
     wall_q, n_q = qreps[len(qreps) // 2]
-    _q_lo = round(min(nn / w for w, nn in qreps), 1)
-    _q_hi = round(max(nn / w for w, nn in qreps), 1)
     configs["queue-10k-single-pcomp"] = {
         "ops": n_q,
         "wall_s": round(wall_q, 3),
         "ops_per_s": round(n_q / wall_q, 1),
         "verdicts": {"true": 1, "false": 0, "unknown": 0},
-        "spread": {
-            "k": 3,
-            "ops_per_s_min": _q_lo,
-            "ops_per_s_max": _q_hi,
-            "ratio": round(_q_hi / max(_q_lo, 1e-9), 2),
-        },
+        "spread": spread_dict(min(nn / w for w, nn in qreps),
+                              max(nn / w for w, nn in qreps), 3),
     }
     log(f"queue-10k-single-pcomp: {configs['queue-10k-single-pcomp']}")
 
@@ -663,6 +660,9 @@ def main():
             crossover[f"deep-{n_keys}"] = backend_walls(
                 n_keys, 64, 0.3, 4_000, seed=run_seed + 900 + n_keys,
                 xla=False, k=3)
+            crossover[f"deep-{n_keys}"]["pallas_kernel_ms"] = (
+                pallas_kernel_resident_ms(
+                    n_keys, 64, 0.3, 4_000, seed=run_seed + 950 + n_keys))
             log(f"crossover deep-{n_keys}: "
                 f"{crossover[f'deep-{n_keys}']}")
     configs["tpu-vs-native"] = crossover

@@ -688,10 +688,15 @@ def _layout(flats: dict, idx, n_pad: int,
     else:
         idx = np.asarray(idx, np.int64)
         ns = ns_all[idx]
-        total_sel = int(ns.sum())
-        cum = np.cumsum(ns) - ns
-        sel = (np.repeat(offs[idx] - cum, ns)
-               + np.arange(total_sel, dtype=np.int64))
+        if len(idx) and np.all(np.diff(idx) == 1):
+            # contiguous lane range (the chunked-launch case): a plain
+            # slice instead of a fancy-index copy of the flat arrays
+            sel = slice(int(offs[idx[0]]), int(offs[idx[-1] + 1]))
+        else:
+            total_sel = int(ns.sum())
+            cum = np.cumsum(ns) - ns
+            sel = (np.repeat(offs[idx] - cum, ns)
+                   + np.arange(total_sel, dtype=np.int64))
     n_lanes = len(ns)
     # block counts bucket to powers of two so re-batches (the two-pass
     # scheduler's survivor pass) reuse compiled kernels instead of
