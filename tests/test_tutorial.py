@@ -23,6 +23,7 @@ CHAPTERS = [
     "06-refining",
     "07-parameters",
     "08-set",
+    "09-tpu-analysis",
 ]
 
 
