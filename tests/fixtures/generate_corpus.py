@@ -37,6 +37,7 @@ from jepsen_tpu.history import Op, index  # noqa: E402
 from jepsen_tpu.models import (  # noqa: E402
     CASRegister,
     FIFOQueue,
+    MultiRegister,
     Mutex,
     Register,
     UnorderedQueue,
@@ -52,6 +53,7 @@ MODELS = {
     "mutex": Mutex,
     "unordered-queue": UnorderedQueue,
     "fifo-queue": FIFOQueue,
+    "multi-register": MultiRegister,
 }
 
 #: brute force is exact but exponential; cap the entry count it sees
@@ -610,6 +612,125 @@ def r3_cases():
             {"seed": 9900 + i, "corrupt": corrupt},
             expect_valid=True if corrupt == 0.0 else None,
         ))
+
+    # --- r5 bands ---------------------------------------------------
+    # Fifo ring edges: the pallas lane kernel sizes its ring to
+    # next_pow2(enqueue count) with FIFO_MAX_RING = 64 the eligibility
+    # bound, so these pin the boundary shapes — full ring, a
+    # misordered pair AT the boundary, a concurrent race at the
+    # boundary, and a crash-thinned full ring. All engines cover the
+    # shallow shapes; the crash-thinned case needs ~8k+ host steps, so
+    # interpret-mode CI covers it on host/linear/native/XLA only and
+    # its Mosaic-kernel coverage comes from the hardware corpus replay
+    # (COVERAGE.md "hardware parity").
+    from jepsen_tpu.history import info_op, invoke_op, ok_op
+
+    for n_enq in (16, 63, 64):
+        enqs = []
+        for v in range(n_enq):
+            enqs += [invoke_op(v % 3, "enqueue", v),
+                     ok_op(v % 3, "enqueue", v)]
+        good = list(enqs)
+        for v in range(n_enq):
+            good += [invoke_op(3, "dequeue"), ok_op(3, "dequeue", v)]
+        cases.append(case(f"fifo-ring-full-{n_enq}", "fifo-queue",
+                          index(good), {"n_enq": n_enq}, True))
+        bad = list(enqs)
+        for v in (list(range(n_enq - 2)) + [n_enq - 1, n_enq - 2]):
+            bad += [invoke_op(3, "dequeue"), ok_op(3, "dequeue", v)]
+        cases.append(case(f"fifo-ring-misorder-{n_enq}", "fifo-queue",
+                          index(bad), {"n_enq": n_enq}, False))
+    race = []
+    for v in range(62):
+        race += [invoke_op(v % 3, "enqueue", v),
+                 ok_op(v % 3, "enqueue", v)]
+    race += [invoke_op(0, "enqueue", 62), invoke_op(1, "enqueue", 63),
+             ok_op(0, "enqueue", 62), ok_op(1, "enqueue", 63)]
+    # the racing pair may linearize either way round
+    for v in list(range(62)) + [63, 62]:
+        race += [invoke_op(3, "dequeue"), ok_op(3, "dequeue", v)]
+    cases.append(case("fifo-ring-race-64", "fifo-queue", index(race),
+                      {"n_enq": 64}, True))
+    crashy = []
+    sure = []
+    for v in range(64):
+        # two optional (crashed) enqueues: each stays concurrent with
+        # EVERYTHING after it, so more than a couple makes the search
+        # genuinely intractable for every oracle (measured: 8 crashed
+        # exhausts 5M wgl steps AND 300k linear configs)
+        if v in (31, 63):
+            crashy += [invoke_op(v % 3, "enqueue", v),
+                       info_op(v % 3, "enqueue", v)]
+        else:
+            crashy += [invoke_op(v % 3, "enqueue", v),
+                       ok_op(v % 3, "enqueue", v)]
+            sure.append(v)
+    for v in sure:
+        crashy += [invoke_op(3, "dequeue"), ok_op(3, "dequeue", v)]
+    cases.append(case("fifo-ring-crashy-64", "fifo-queue",
+                      index(crashy), {"n_enq": 64}, None))
+
+    # Multi-register (knossos.model/multi-register): single-key txn
+    # histories (the P-compositional shape) and coupled two-key txns
+    # (which must stay on the full search), with crashed writes and
+    # occasionally corrupted reads — verdicts from the oracles.
+    def corpus_mreg_history(n_process=3, n_ops=14, seed=0,
+                            corrupt=0.0, coupled=False):
+        rng = random.Random(seed)
+        regs = {}
+        history, t = [], 0
+        keys = ["x", "y", "z"]
+        for i in range(n_ops):
+            p = i % n_process
+            if coupled and rng.random() < 0.4:
+                micros = [["w", k, rng.randrange(4)]
+                          for k in rng.sample(keys, 2)]
+                history.append(Op(p, "invoke", "txn", micros,
+                                  time=t, index=t))
+                t += 1
+                kind = "info" if rng.random() < 0.1 else "ok"
+                history.append(Op(p, kind, "txn", micros,
+                                  time=t, index=t))
+                t += 1
+                if kind == "ok":
+                    for _f, k, v in micros:
+                        regs[k] = v
+                continue
+            k = rng.choice(keys)
+            if rng.random() < 0.5:
+                v = rng.randrange(4)
+                micros = [["w", k, v]]
+                history.append(Op(p, "invoke", "txn", micros,
+                                  time=t, index=t))
+                t += 1
+                kind = "info" if rng.random() < 0.12 else "ok"
+                history.append(Op(p, kind, "txn", micros,
+                                  time=t, index=t))
+                t += 1
+                if kind == "ok":
+                    regs[k] = v
+            else:
+                v = regs.get(k)
+                if v is not None and rng.random() < corrupt:
+                    v += 10  # off every legal value
+                micros = [["r", k, v]]
+                history.append(Op(p, "invoke", "txn", micros,
+                                  time=t, index=t))
+                t += 1
+                history.append(Op(p, "ok", "txn", micros,
+                                  time=t, index=t))
+                t += 1
+        return index(history)
+
+    for i in range(10):
+        corrupt = (0.0, 0.5)[i % 2]
+        coupled = i % 4 >= 2
+        hist = corpus_mreg_history(n_ops=12 + 2 * i, seed=12000 + i,
+                                   corrupt=corrupt, coupled=coupled)
+        cases.append(case(
+            f"multi-register-{i}", "multi-register", hist,
+            {"seed": 12000 + i, "corrupt": corrupt, "coupled": coupled},
+            expect_valid=True if corrupt == 0.0 else None))
 
     return cases
 

@@ -15,7 +15,8 @@ import os
 import pytest
 
 from jepsen_tpu.history import entries as make_entries, ops as to_ops
-from jepsen_tpu.models import (CASRegister, FIFOQueue, Mutex, Register,
+from jepsen_tpu.models import (CASRegister, FIFOQueue, MultiRegister,
+                               Mutex, Register,
                                UnorderedQueue)
 from jepsen_tpu.models import jit as mjit
 from jepsen_tpu.ops import linear, wgl_host
@@ -29,6 +30,7 @@ MODELS = {
     "mutex": Mutex,
     "unordered-queue": UnorderedQueue,
     "fifo-queue": FIFOQueue,
+    "multi-register": MultiRegister,
 }
 
 
@@ -222,7 +224,23 @@ class TestPallasVecParity:
             if wgl_host.analysis(model, es,
                                  max_steps=1_200).valid == "unknown":
                 # interpret mode costs milliseconds PER LOCKSTEP
-                # ITERATION — only shallow searches are affordable
+                # ITERATION — only shallow searches are affordable.
+                # Like TestTpuParity's filter, narrowing coverage of
+                # anything outside the known-deep bands must FAIL
+                # loudly, not silently skip. (fifo-ring-crashy needs
+                # ~8k+ host steps — crashed entries stay concurrent
+                # with everything after — so its Mosaic coverage
+                # comes from the hardware corpus replay, COVERAGE.md
+                # "hardware parity", not from interpret-mode CI.)
+                assert (case["params"].get("large")
+                        or case["params"].get("adversarial")
+                        or "-r3-" in case["name"]
+                        or case["name"].startswith(
+                            ("cas-5p-", "queue-crashy", "fifo-crashy",
+                             "fifo-ring-crashy", "wide-window",
+                             "staircase", "etcd-"))), (
+                    f"depth filter would drop pre-existing pallas "
+                    f"coverage: {case['name']}")
                 continue
             if not wgl_pallas_vec.batch_eligible(jm, [es]):
                 continue  # incl. fifo lanes beyond FIFO_MAX_RING
