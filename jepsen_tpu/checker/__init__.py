@@ -117,9 +117,11 @@ from .perf import (  # noqa: E402
     perf as perf_checker,
     rate_graph_checker as rate_graph,
 )
+from .recovery import RecoveryChecker, recovery  # noqa: E402
 
 __all__ = [
     "Checker",
+    "RecoveryChecker",
     "check_safe",
     "clock_plot",
     "compose",
@@ -131,6 +133,7 @@ __all__ = [
     "perf_checker",
     "queue",
     "rate_graph",
+    "recovery",
     "set_checker",
     "set_full",
     "timeline_html",

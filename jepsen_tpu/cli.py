@@ -39,7 +39,13 @@ class CliError(Exception):
 
 class _Parser(argparse.ArgumentParser):
     """argparse, but option errors raise CliError (exit 254) instead of
-    argparse's exit(2)."""
+    argparse's exit(2). conflict_handler="resolve" lets a suite's
+    opt_spec redefine a standard option (e.g. --nemesis with its own
+    registry names) instead of crashing the parser build."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("conflict_handler", "resolve")
+        super().__init__(*args, **kwargs)
 
     def error(self, message):
         raise CliError(message)
@@ -90,6 +96,27 @@ def test_opt_spec(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--store-dir", default=None, metavar="DIR",
         help="Root directory for test results (default ./store)",
+    )
+    # The nemesis/seed options default to SUPPRESS, not None: test maps
+    # do test.update(opts), and a present-but-None "nemesis" key would
+    # clobber a suite's nemesis object.
+    parser.add_argument(
+        "--nemesis", default=argparse.SUPPRESS, metavar="SPEC",
+        help="Fault mode: a suite registry name (e.g. parts), or a "
+        "comma-separated list of fault families (partition, clock, "
+        "kill, pause, corruption, packet) for a composed nemesis "
+        "package with verified recovery. Suites may redefine this "
+        "option with their own default.",
+    )
+    parser.add_argument(
+        "--nemesis-interval", type=float, default=argparse.SUPPRESS,
+        metavar="SECONDS",
+        help="Seconds between scheduled nemesis operations (default 10)",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=argparse.SUPPRESS, metavar="N",
+        help="Seed the composed nemesis package's RNG so the fault "
+        "schedule is reproducible",
     )
 
 

@@ -573,6 +573,29 @@ def analyze(test) -> dict:
     return test
 
 
+def with_recovery_phases(test) -> Any:
+    """The recovery contract (nemesis/combined.clj's final-generator):
+    once the main generator is exhausted, the nemesis runs every fault
+    package's heal generator (test["final_generator"]), then — when
+    test["stability_period"] and test["stability_generator"] are set —
+    clients run a plain-op stability window so checker.recovery has a
+    post-heal view to audit. Phases are barrier-synchronized: no heal
+    starts while a client still draws main-phase ops."""
+    main = test.get("generator")
+    phase_list = [main]
+    final = test.get("final_generator")
+    if final is not None:
+        phase_list.append(generator.nemesis(final))
+    period = test.get("stability_period")
+    stability = test.get("stability_generator")
+    if period and stability is not None:
+        phase_list.append(
+            generator.time_limit(period, generator.clients(stability)))
+    if len(phase_list) == 1:
+        return main
+    return generator.phases(*phase_list)
+
+
 def prepare(test: dict) -> dict:
     """Fill in derived test-map fields (core.clj:593-608)."""
     test = dict(test)
@@ -581,6 +604,7 @@ def prepare(test: dict) -> dict:
     test.setdefault("start_time", datetime.datetime.now())
     test["active_histories"] = []
     test["remote"] = control.remote_for_test(test)
+    test["generator"] = with_recovery_phases(test)
     return test
 
 

@@ -30,6 +30,48 @@ class Primary:
     def setup_primary(self, test, node) -> None:
         raise NotImplementedError
 
+    def primaries(self, test) -> list:
+        """Nodes currently believed to be primaries (db.clj:18-22).
+        Single-leader systems should override with a real leader probe;
+        the default — the setup_primary node — matches the reference's
+        degenerate case."""
+        nodes = test.get("nodes") or []
+        return nodes[:1]
+
+
+class Process:
+    """Mixin: the DB can report whether its process runs on a node
+    (db.clj ::Process). alive() answers True/False, or None when the
+    node has no record of the process at all (e.g. no pidfile)."""
+
+    def alive(self, test, node):
+        raise NotImplementedError
+
+
+class Kill(Process):
+    """Mixin: the DB's process can be killed and restarted on demand
+    (db.clj ::Kill). kill() must be crash-like (SIGKILL, no graceful
+    shutdown); start() must be idempotent — starting a running node is
+    a no-op, so heal phases can blanket-restart."""
+
+    def kill(self, test, node) -> None:
+        raise NotImplementedError
+
+    def start(self, test, node) -> None:
+        raise NotImplementedError
+
+
+class Pause(Process):
+    """Mixin: the DB's process can be paused (SIGSTOP) and resumed
+    (SIGCONT) (db.clj ::Pause). Both must be idempotent for the same
+    reason Kill.start is."""
+
+    def pause(self, test, node) -> None:
+        raise NotImplementedError
+
+    def resume(self, test, node) -> None:
+        raise NotImplementedError
+
 
 class LogFiles:
     """Mixin: per-node log file paths to snarf at test end (db.clj:15-16)."""

@@ -49,12 +49,17 @@ class SuiteCfg:
         return self.cfg(test).get("sudo", True)
 
 
-class ArchiveDB(db.DB, db.LogFiles):
+class ArchiveDB(db.DB, db.Kill, db.Pause, db.LogFiles):
     """The common suite DB shape: install an archive, start one daemon,
     poll until ready, stop + wipe on teardown. Subclasses provide
     `binary`, `daemon_args(test, node)`, and `probe_ready(test, node)
     -> bool`; anything extra (cluster joins, bootstrap flags) hooks in
-    via `post_start(test, node)`."""
+    via `post_start(test, node)`.
+
+    Implements db.Kill (SIGKILL via pidfile + the shared start()) and
+    db.Pause (SIGSTOP/SIGCONT), so every archive suite — mongodb's
+    mongo_sim-backed MongoDB included — can host the kill/pause fault
+    families from nemesis.combined."""
 
     binary = "server"
     log_name = "server.log"
@@ -130,6 +135,34 @@ class ArchiveDB(db.DB, db.LogFiles):
 
     def log_files(self, test, node) -> list:
         return [f"{self.suite.dir(test, node)}/{self.log_name}"]
+
+    # -- db.Kill / db.Pause / db.Process ------------------------------------
+
+    def _pidfile(self, test, node) -> str:
+        return f"{self.suite.dir(test, node)}/{self.pid_name}"
+
+    def kill(self, test, node) -> None:
+        """Crash-like stop: SIGKILL via pidfile (db.Kill). start() above
+        is the matching revive — the same invocation setup uses."""
+        cu.stop_daemon(test["remote"], node, self._pidfile(test, node))
+
+    def _signal(self, test, node, sig: str) -> None:
+        r = test["remote"].exec(node, ["cat", self._pidfile(test, node)],
+                                check=False)
+        pid = r.out.strip()
+        if pid:
+            test["remote"].exec(node, ["kill", f"-{sig}", pid],
+                                check=False)
+
+    def pause(self, test, node) -> None:
+        self._signal(test, node, "STOP")
+
+    def resume(self, test, node) -> None:
+        self._signal(test, node, "CONT")
+
+    def alive(self, test, node):
+        return cu.daemon_running(test["remote"], node,
+                                 self._pidfile(test, node))
 
 
 def shared_flag() -> dict:
@@ -518,14 +551,55 @@ def fsfault_wiring(db_, opts: dict, data_dir_fn):
 
 
 def nemesis_opt(p, names=NEMESIS_NAMES, default: str = "parts") -> None:
-    """argparse surface for --nemesis. Suites whose DB can't host the
-    kill/pause modes pass PARTITION_NEMESIS_NAMES so the flag is
-    rejected at parse time, not at test-build time. The argparse
-    default IS `default`, so the help text and the resolved nemesis
-    can't drift (pick_nemesis's own default only covers programmatic
-    callers that skip the CLI)."""
-    p.add_argument("--nemesis", default=default, choices=list(names),
-                   help=f"named fault mode (default: {default})")
+    """argparse surface for --nemesis. The value is either a registry
+    name from `names` (validated at test-build time by pick_nemesis) or
+    a comma list of fault families ("kill,partition") resolved into a
+    composed nemesis package by fault_package_wiring — open-ended, so
+    no argparse `choices` gate. The argparse default IS `default`, so
+    the help text and the resolved nemesis can't drift (pick_nemesis's
+    own default only covers programmatic callers that skip the CLI)."""
+    from ..nemesis.combined import FAULT_FAMILIES
+
+    p.add_argument(
+        "--nemesis", default=default, metavar="SPEC",
+        help=f"named fault mode (one of: {', '.join(names)}), or a "
+        f"comma list of fault families ({', '.join(FAULT_FAMILIES)}) "
+        f"for a composed package (default: {default})")
+
+
+def fault_package_wiring(test: dict, db_, opts: dict,
+                         stability_generator=None,
+                         corrupt_paths=None,
+                         set_time_fn=None) -> bool:
+    """When --nemesis names fault families ("kill,partition"), build
+    the composed NemesisPackage and install it into the test map —
+    nemesis, schedules, heal phase, stability window, recovery checker
+    (nemesis.combined.wire_package). The test map's CURRENT generator
+    must be the client-side generator; wiring wraps it. Returns True
+    when wired, False when --nemesis is a plain registry name for
+    pick_nemesis."""
+    from ..nemesis import combined
+
+    fams = combined.parse_fault_spec(opts.get("nemesis"))
+    if fams is None:
+        return False
+    pkg = combined.nemesis_package(
+        faults=fams,
+        db=db_,
+        seed=opts.get("seed"),
+        interval=opts.get("nemesis_interval", 10.0),
+        fault_ops=opts.get("fault_ops"),
+        corrupt_paths=corrupt_paths,
+        set_time_fn=set_time_fn,
+        targets=opts.get("targets"),
+    )
+    combined.wire_package(test, pkg, {
+        "time_limit": opts.get("time_limit", 60),
+        "stability_period": opts.get("stability_period", 10.0),
+        "stability_generator": stability_generator,
+        "recovery_min_ok": opts.get("recovery_min_ok", 1),
+    })
+    return True
 
 
 def resp_ping_ready(suite: SuiteCfg, test, node,

@@ -89,8 +89,11 @@ def node_dir(test, node) -> str:
 # ---------------------------------------------------------------------------
 # DB (etcd.clj:51-86)
 
-class EtcdDB(db.DB, db.LogFiles):
-    """Installs and runs one etcd member per node."""
+class EtcdDB(db.DB, db.Kill, db.Pause, db.LogFiles):
+    """Installs and runs one etcd member per node. Implements the
+    Kill/Pause process protocols over the daemon pidfile, so the
+    kill/pause nemesis packages work against both real clusters and
+    the in-repo simulator (which runs as a genuine subprocess)."""
 
     def __init__(self, version: str = VERSION, url: str | None = None,
                  ready_timeout: float = 30.0):
@@ -152,6 +155,33 @@ class EtcdDB(db.DB, db.LogFiles):
             if time.monotonic() > deadline:
                 raise db.SetupFailed(f"etcd on {node} never became ready")
             time.sleep(0.2)
+
+    # -- db.Kill / db.Pause (start(test, node) above doubles as the
+    #    Kill revival path; it re-runs start_daemon, which is a no-op
+    #    when the pidfile still points at a live process)
+
+    def _pidfile(self, test, node) -> str:
+        return f"{node_dir(test, node)}/etcd.pid"
+
+    def kill(self, test, node) -> None:
+        cu.stop_daemon(test["remote"], node, self._pidfile(test, node))
+
+    def _signal(self, test, node, sig: str) -> None:
+        r = test["remote"].exec(node, ["cat", self._pidfile(test, node)],
+                                check=False)
+        pid = (r.out or "").strip()
+        if pid:
+            test["remote"].exec(node, ["kill", f"-{sig}", pid], check=False)
+
+    def pause(self, test, node) -> None:
+        self._signal(test, node, "STOP")
+
+    def resume(self, test, node) -> None:
+        self._signal(test, node, "CONT")
+
+    def alive(self, test, node):
+        return cu.daemon_running(test["remote"], node,
+                                 self._pidfile(test, node))
 
     def teardown(self, test, node) -> None:
         remote = test["remote"]
@@ -307,6 +337,22 @@ def data_dir(test, node) -> str:
     return f"{node_dir(test, node)}/{node}.etcd"
 
 
+def client_generator(opts: dict, start_key: int = 0):
+    """The independent-keys CAS workload (etcd.clj:166-176). start_key
+    offsets the key space so a second instance (the post-heal stability
+    window) never collides with the main body's keys."""
+    per_key = opts.get("ops_per_key", 300)
+    threads_per_key = opts.get("threads_per_key", 10)
+    return independent.concurrent_generator(
+        threads_per_key,
+        itertools.count(start_key),
+        lambda k: gen.limit(
+            per_key,
+            gen.stagger(1 / 30, gen.mix([r, w, cas])),
+        ),
+    )
+
+
 def etcd_test(opts: dict) -> dict:
     from ..testlib import noop_test
 
@@ -317,18 +363,13 @@ def etcd_test(opts: dict) -> dict:
     # the nemesis only flips the fault switch — etcd is statically
     # linked Go, so the LD_PRELOAD backend can't touch it
     db_, nemesis_ = cmn.fsfault_wiring(db_, opts, data_dir)
-    if nemesis_ is None:
-        nemesis_ = cmn.pick_nemesis(db_, opts)
     test = noop_test()
-    per_key = opts.get("ops_per_key", 300)
-    threads_per_key = opts.get("threads_per_key", 10)
     test.update(
         {
             "name": "etcd",
             "os": osdist.debian,
             "db": db_,
             "client": EtcdClient(),
-            "nemesis": nemesis_,
             "model": models.CASRegister(),
             "checker": checker_mod.compose({
                 "perf": checker_mod.perf_checker(),
@@ -337,6 +378,21 @@ def etcd_test(opts: dict) -> dict:
                     "linear": checker_mod.linearizable(),
                 })),
             }),
+            "generator": client_generator(opts),
+        }
+    )
+    if nemesis_ is None and cmn.fault_package_wiring(
+            test, db_, opts,
+            stability_generator=client_generator(opts, start_key=1_000_000),
+            corrupt_paths=opts.get("corrupt_paths")
+            or [lambda t, n: f"{node_dir(t, n)}/etcd.log"]):
+        # composed package: generator/nemesis/checker installed in place
+        pass
+    else:
+        if nemesis_ is None:
+            nemesis_ = cmn.pick_nemesis(db_, opts)
+        test.update({
+            "nemesis": nemesis_,
             "generator": gen.time_limit(
                 opts.get("time_limit", 60),
                 gen.nemesis(
@@ -346,24 +402,18 @@ def etcd_test(opts: dict) -> dict:
                         gen.sleep(5),
                         {"type": "info", "f": "stop"},
                     ])),
-                    independent.concurrent_generator(
-                        threads_per_key,
-                        itertools.count(),
-                        lambda k: gen.limit(
-                            per_key,
-                            gen.stagger(1 / 30, gen.mix([r, w, cas])),
-                        ),
-                    ),
+                    test["generator"],
                 ),
             ),
-        }
-    )
+        })
     # The reference merges opts last (etcd.clj:152,181) so CLI options
     # like nodes/ssh/concurrency override suite defaults. "nemesis" is
     # consumed above (resolved into a nemesis OBJECT) — merging the raw
     # string back over it would hand core.run a str.
     consumed = {"version", "archive_url", "ops_per_key", "threads_per_key",
-                "time_limit", "nemesis", "fsfault_opt_dir"}
+                "time_limit", "nemesis", "fsfault_opt_dir",
+                "nemesis_interval", "seed", "stability_period",
+                "fault_ops", "corrupt_paths", "recovery_min_ok", "targets"}
     test.update({k: v for k, v in opts.items() if k not in consumed})
     return test
 

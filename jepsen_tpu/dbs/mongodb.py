@@ -398,8 +398,21 @@ def mongodb_test(opts: dict) -> dict:
             "os": osdist.smartos if flavor == "smartos" else osdist.debian,
             "db": db_,
             "client": wl["client"],
-            "nemesis": cmn.pick_nemesis(db_, opts),
             "model": wl.get("model"),
+            "generator": wl["during"],
+            "checker": wl["checker"],
+        }
+    )
+    # MongoDB inherits kill/pause from ArchiveDB, so composed fault
+    # packages ("--nemesis kill,partition") work out of the box; plain
+    # registry names fall through to pick_nemesis as before.
+    if not cmn.fault_package_wiring(
+            test, db_, opts,
+            stability_generator=wl["during"],
+            corrupt_paths=opts.get("corrupt_paths")
+            or [lambda t, n: f"{db_.suite.dir(t, n)}/{db_.log_name}"]):
+        test.update({
+            "nemesis": cmn.pick_nemesis(db_, opts),
             "generator": gen.time_limit(
                 opts.get("time_limit", 60),
                 gen.nemesis(
@@ -407,9 +420,7 @@ def mongodb_test(opts: dict) -> dict:
                     wl["during"],
                 ),
             ),
-            "checker": wl["checker"],
-        }
-    )
+        })
     test.update(wl.get("test_opts") or {})
     return test
 

@@ -370,17 +370,19 @@ class Mix(Generator):
     parity; pace members with short intervals when mixing them under
     time_limit."""
 
-    def __init__(self, gens: Sequence):
+    def __init__(self, gens: Sequence, rng: random.Random | None = None):
         self.gens = [to_gen(g) for g in gens]
+        # seeded rng => reproducible interleaving (fault schedules)
+        self.rng = rng or random
 
     def op(self, test, process):
         if not self.gens:
             return None
-        return random.choice(self.gens).op(test, process)
+        return self.rng.choice(self.gens).op(test, process)
 
 
-def mix(gens) -> Generator:
-    return Mix(gens) if gens else void
+def mix(gens, rng: random.Random | None = None) -> Generator:
+    return Mix(gens, rng=rng) if gens else void
 
 
 class CasGen(Generator):

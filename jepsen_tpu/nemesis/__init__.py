@@ -54,11 +54,11 @@ def bisect(coll: Iterable) -> tuple[list, list]:
     return coll[:mid], coll[mid:]
 
 
-def split_one(coll: Iterable, node=None) -> tuple[list, list]:
+def split_one(coll: Iterable, node=None, rng=None) -> tuple[list, list]:
     """Isolate one node (the given one, or random) from the rest
-    (nemesis.clj:64-73)."""
+    (nemesis.clj:64-73). Pass a seeded rng for reproducible picks."""
     coll = list(coll)
-    node = node if node is not None else _random.choice(coll)
+    node = node if node is not None else (rng or _random).choice(coll)
     return [node], [n for n in coll if n != node]
 
 
@@ -88,14 +88,15 @@ def bridge(nodes: Iterable) -> dict:
     return grudge
 
 
-def majorities_ring(nodes: Iterable) -> dict:
+def majorities_ring(nodes: Iterable, rng=None) -> dict:
     """Every node sees a majority, but no two nodes see the same majority
     (nemesis.clj:134-147): node i is connected to the majority-sized
-    window of the (shuffled) ring starting at its position."""
+    window of the (shuffled) ring starting at its position. Pass a
+    seeded rng for a reproducible ring."""
     nodes = list(nodes)
     n = len(nodes)
     ring = list(nodes)
-    _random.shuffle(ring)
+    (rng or _random).shuffle(ring)
     m = majority(n)
     grudge = {}
     for i, node in enumerate(ring):
@@ -152,25 +153,26 @@ def partition_halves() -> Partitioner:
     return Partitioner(lambda nodes: complete_grudge(bisect(nodes)))
 
 
-def partition_random_halves() -> Partitioner:
+def partition_random_halves(rng=None) -> Partitioner:
     """Two RANDOM halves (nemesis.clj:126-132)."""
 
     def grudge(nodes):
         nodes = list(nodes)
-        _random.shuffle(nodes)
+        (rng or _random).shuffle(nodes)
         return complete_grudge(bisect(nodes))
 
     return Partitioner(grudge)
 
 
-def partition_random_node() -> Partitioner:
+def partition_random_node(rng=None) -> Partitioner:
     """Isolate a single random node (nemesis.clj:107-116 via split-one)."""
-    return Partitioner(lambda nodes: complete_grudge(split_one(nodes)))
+    return Partitioner(
+        lambda nodes: complete_grudge(split_one(nodes, rng=rng)))
 
 
-def partition_majorities_ring() -> Partitioner:
+def partition_majorities_ring(rng=None) -> Partitioner:
     """Intersecting majorities ring partition (nemesis.clj:149-156)."""
-    return Partitioner(majorities_ring)
+    return Partitioner(lambda nodes: majorities_ring(nodes, rng=rng))
 
 
 # ---------------------------------------------------------------------------
@@ -221,24 +223,43 @@ def set_time(remote, node, t: float) -> None:
 
 class ClockScrambler(Nemesis):
     """Randomizes node clocks within a ±dt-second window
-    (nemesis.clj:203-218)."""
+    (nemesis.clj:203-218). A "reset"/"stop" op (and teardown) snaps
+    every clock back to real time, so clock faults are revocable like
+    partitions. set_time_fn(test, node, t) is injectable — hermetic
+    sandboxes can't run `date -s`."""
 
-    def __init__(self, dt: float):
+    def __init__(self, dt: float, rng=None, set_time_fn=None):
         self.dt = dt
+        self.rng = rng or _random
+        self.set_time_fn = set_time_fn
+
+    def _set(self, test, node, t):
+        if self.set_time_fn is not None:
+            self.set_time_fn(test, node, t)
+        else:
+            set_time(test["remote"], node, t)
 
     def invoke(self, test, op):
         import time as _time
 
         from ..control import on_nodes
 
-        remote = test["remote"]
+        if op.f in ("reset", "stop"):
+            on_nodes(test,
+                     lambda t, node: self._set(test, node, _time.time()))
+            return op.with_(type="info", value="clocks reset")
+
         dt = self.dt
+        # draw every offset up front, under one lock-free pass, so a
+        # seeded rng yields the same schedule regardless of on_nodes's
+        # thread interleaving
+        offsets = {node: self.rng.uniform(-dt, dt)
+                   for node in test["nodes"]}
 
         def scramble(t, node):
             # uniform over [-dt, dt); randrange would TypeError on a
             # float dt (the reference's rand-int coerces doubles)
-            set_time(remote, node,
-                     _time.time() + _random.uniform(-dt, dt))
+            self._set(test, node, _time.time() + offsets[node])
 
         return op.with_(value=on_nodes(test, scramble))
 
@@ -247,12 +268,11 @@ class ClockScrambler(Nemesis):
 
         from ..control import on_nodes
 
-        remote = test["remote"]
-        on_nodes(test, lambda t, node: set_time(remote, node, _time.time()))
+        on_nodes(test, lambda t, node: self._set(test, node, _time.time()))
 
 
-def clock_scrambler(dt: float) -> ClockScrambler:
-    return ClockScrambler(dt)
+def clock_scrambler(dt: float, rng=None, set_time_fn=None) -> ClockScrambler:
+    return ClockScrambler(dt, rng=rng, set_time_fn=set_time_fn)
 
 
 class NodeStartStopper(Nemesis):
@@ -271,13 +291,15 @@ class NodeStartStopper(Nemesis):
             if self.affected:
                 return op.with_(type="info", value="already affecting nodes")
             targets = list(self.targeter(list(test["nodes"])))
+            # record BEFORE acting: if stop_fn crashes midway (or the
+            # run aborts) teardown still knows which nodes to revive
+            self.affected = targets
             res = dict(
                 zip(
                     targets,
                     real_pmap(lambda n: self.stop_fn(test, n), targets),
                 )
             )
-            self.affected = targets
             return op.with_(type="info", value=res)
         if op.f == "stop":
             targets = self.affected
@@ -290,6 +312,17 @@ class NodeStartStopper(Nemesis):
             self.affected = []
             return op.with_(type="info", value=res)
         raise ValueError(f"node_start_stopper can't handle {op.f!r}")
+
+    def teardown(self, test):
+        """Fault revocation: best-effort revive whatever is still down,
+        so an aborted run can't leave nodes killed/paused forever."""
+        targets, self.affected = self.affected, []
+        for n in targets:
+            try:
+                self.start_fn(test, n)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                log.warning("couldn't revive %s during teardown", n,
+                            exc_info=True)
 
 
 def node_start_stopper(targeter, stop_fn, start_fn) -> NodeStartStopper:
@@ -339,3 +372,39 @@ class TruncateFile(Nemesis):
 
 def truncate_file(path, drop_bytes=1, targeter=None) -> TruncateFile:
     return TruncateFile(path, drop_bytes, targeter)
+
+
+class BitflipFile(Nemesis):
+    """Overwrite one byte of a file with random garbage on targeted
+    nodes — silent on-disk corruption, the bitflip sibling of
+    TruncateFile (jepsen.nemesis.file's corrupt-file! bitflip mode)."""
+
+    def __init__(self, path: str, targeter=None, rng=None):
+        self.path = path
+        self.targeter = targeter or (lambda nodes: [_random.choice(nodes)])
+        self.rng = rng or _random
+
+    def invoke(self, test, op):
+        assert op.f == "bitflip"
+        targets = list(self.targeter(list(test["nodes"])))
+        offsets = {}
+        for node in targets:
+            # pick the offset from the file's tail region; seek past EOF
+            # would silently extend the file instead of corrupting it
+            size_out = test["remote"].exec(
+                node, ["wc", "-c", self.path], check=False
+            ).out.split()
+            size = int(size_out[0]) if size_out else 0
+            offset = self.rng.randrange(max(1, size))
+            offsets[node] = offset
+            test["remote"].exec(
+                node,
+                ["dd", "if=/dev/urandom", f"of={self.path}", "bs=1",
+                 "count=1", f"seek={offset}", "conv=notrunc"],
+                sudo=True,
+            )
+        return op.with_(type="info", value={"bitflipped": offsets})
+
+
+def bitflip_file(path, targeter=None, rng=None) -> BitflipFile:
+    return BitflipFile(path, targeter=targeter, rng=rng)

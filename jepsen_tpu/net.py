@@ -114,11 +114,14 @@ class IPTables(Net):
         real_pmap(heal_one, test["nodes"])
 
     def slow(self, test):
+        # "replace" instead of "add": a second slow/flaky op must swap
+        # the netem discipline, not die with RTNETLINK "File exists"
+        # and poison the nemesis worker
         real_pmap(
             lambda node: self._exec(
                 test,
                 node,
-                ["tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                ["tc", "qdisc", "replace", "dev", "eth0", "root", "netem",
                  "delay", "50ms", "10ms", "distribution", "normal"],
             ),
             test["nodes"],
@@ -129,7 +132,7 @@ class IPTables(Net):
             lambda node: self._exec(
                 test,
                 node,
-                ["tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                ["tc", "qdisc", "replace", "dev", "eth0", "root", "netem",
                  "loss", "20%", "75%"],
             ),
             test["nodes"],
