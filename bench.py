@@ -1,6 +1,15 @@
 """Benchmark: all five BASELINE.md configs plus an invalid-heavy lane.
 
-Prints ONE JSON line on stdout (progress goes to stderr):
+Output contract (ISSUE 2): the LAST stdout line is a compact standalone
+JSON summary (<= 1,500 bytes — driver tail truncation must never eat the
+headline): metric, value, unit, vs_baseline, backend, cold_compile_s,
+run_seed, a `deep` block with end-to-end walls + kernel-resident
+fractions for the deep refutation lanes (dropped first if the line would
+run over budget), and `full` naming the artifact. The complete
+per-config matrix is written to BENCH_FULL.json next to this file.
+Progress goes to stderr.
+
+Summary/artifact fields:
   metric       the north-star config (10k-op CAS-register history,
                34 independent keys, 5 clients/key — the etcd workload
                shape, etcd.clj:167-173 — checked by the best TPU WGL
@@ -42,6 +51,11 @@ Prints ONE JSON line on stdout (progress goes to stderr):
   cold_compile_s  XLA compile+first-launch cost for the north-star
                shape (warm runs hit the jit cache)
 
+The deep lanes additionally report kernel_resident_frac — the fraction
+of the end-to-end pallas wall spent resident in the device kernel; the
+remainder is encode/pack/tunnel/sync overhead that the pipelined
+chunked dispatch (wgl_pallas_vec.CHUNK_BLOCKS) exists to hide.
+
 Timing honesty: the accelerator tunnel memoizes identical (program,
 input) launches — and the memo PERSISTS across processes — so every
 timed run here uses a batch derived from a fresh per-invocation seed
@@ -68,18 +82,33 @@ def _tpu_usable() -> bool:
     must be killable. A cold axon tunnel can take >45 s to come up
     (VERDICT r4 item 1: the round-4 capture fell to CPU on a marginal
     45 s single shot), so the probe RETRIES with growing budgets before
-    concluding the TPU is gone."""
+    concluding the TPU is gone.
+
+    The probe asserts the default device's PLATFORM, not just that jax
+    initializes (VERDICT r5 weak 2): a leaked JAX_PLATFORMS=cpu makes
+    `jax.devices()` succeed on the CPU backend, which would stamp
+    backend="tpu" on an interpret-mode capture. A definite non-TPU
+    platform answer short-circuits the retries — waiting longer cannot
+    change what the backend IS, only whether it comes up."""
+    probe = ("import jax; d = jax.devices()[0]; "
+             "print('platform=' + d.platform); "
+             "assert d.platform == 'tpu', d.platform; print('ok')")
     for timeout in (60.0, 120.0, 180.0):
         try:
             p = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; jax.devices(); print('ok')"],
+                [sys.executable, "-c", probe],
                 capture_output=True,
                 timeout=timeout,
                 text=True,
             )
             if p.returncode == 0 and "ok" in p.stdout:
                 return True
+            if "platform=" in p.stdout and "platform=tpu" not in p.stdout:
+                plat = [ln for ln in p.stdout.splitlines()
+                        if ln.startswith("platform=")][0]
+                log(f"tpu probe: backend came up as {plat!r}, not tpu "
+                    "(leaked JAX_PLATFORMS?) — not retrying")
+                return False
             log(f"tpu probe failed (rc={p.returncode}); retrying")
         except subprocess.TimeoutExpired:
             log(f"tpu probe timed out at {timeout:.0f}s; retrying")
@@ -145,6 +174,23 @@ def summarize(results, total_ops, elapsed) -> dict:
 # ship as evidence.
 SPREAD_BOUND = 1.5
 SPREAD_HARD = 3.0
+
+# Sub-FAST_LANE_S lanes live in OS-scheduler-noise territory, where a
+# fresh-seed retry at the same rep count just redraws the same noisy
+# distribution (VERDICT r5 weak 4: bank-setfull and
+# queue-10k-single-pcomp shipped above SPREAD_BOUND after retrying
+# once). For those, each re-measure SCALES THE REP COUNT UP — the
+# median of a larger sample is what actually tightens the spread.
+FAST_LANE_S = 0.3
+MAX_REPS = 15
+
+
+def adaptive_k(k: int, wall_s: float) -> int:
+    """The rep count for a re-measure: doubled (+1, capped) for lanes
+    whose median wall is under FAST_LANE_S, unchanged for slow lanes
+    (there, spread is tunnel variance, and more reps would multiply a
+    multi-second wall for no gain)."""
+    return min(2 * k + 1, MAX_REPS) if wall_s < FAST_LANE_S else k
 
 
 def spread_dict(lo: float, hi: float, k: int) -> dict:
@@ -247,9 +293,11 @@ def main():
             min(nn / w for w, nn, _ in reps),
             max(nn / w for w, nn, _ in reps), k)
         if s["spread"]["ratio"] > SPREAD_BOUND and _attempt < 2:
+            k2 = adaptive_k(k, wall)
             log(f"spread {s['spread']['ratio']}x > {SPREAD_BOUND} "
-                f"(attempt {_attempt}); re-measuring with fresh seeds")
-            return timed_batch(m, build_fn, k=k, check=check,
+                f"(attempt {_attempt}); re-measuring with fresh seeds"
+                + (f", k {k}->{k2}" if k2 != k else ""))
+            return timed_batch(m, build_fn, k=k2, check=check,
                                _attempt=_attempt + 1, **kw)
         assert s["spread"]["ratio"] <= SPREAD_HARD, (
             f"lane spread {s['spread']['ratio']}x exceeds the hard bound "
@@ -258,6 +306,35 @@ def main():
         if s["spread"]["ratio"] > SPREAD_BOUND:
             s["noisy"] = True
         return res, s
+
+    def timed_host_lane(run_rep, k=3, _attempt=0):
+        """Median/spread timing for host-side lanes (bank-setfull,
+        queue-10k-single-pcomp). `run_rep(rep)` builds what it needs,
+        times its own measured window, asserts its verdicts, and
+        returns (wall_s, n_ops). Same spread guard as timed_batch, but
+        with the adaptive rep scaling sub-FAST_LANE_S lanes need
+        (VERDICT r5 weak 4): these lanes finish in tens-to-hundreds of
+        ms, where a fresh-seed retry at k=3 just redraws the same
+        OS-noise distribution — each re-measure doubles the rep count
+        instead, and the median of the larger sample converges."""
+        reps = [run_rep(_attempt * 16 + r) for r in range(k)]
+        reps.sort(key=lambda t: t[0] / max(t[1], 1))
+        wall, n = reps[len(reps) // 2]
+        s = spread_dict(min(nn / w for w, nn in reps),
+                        max(nn / w for w, nn in reps), k)
+        if s["ratio"] > SPREAD_BOUND and _attempt < 2:
+            k2 = adaptive_k(k, wall)
+            log(f"host lane spread {s['ratio']}x > {SPREAD_BOUND} "
+                f"(attempt {_attempt}); re-measuring"
+                + (f", k {k}->{k2}" if k2 != k else ""))
+            return timed_host_lane(run_rep, k=k2, _attempt=_attempt + 1)
+        assert s["ratio"] <= SPREAD_HARD, (
+            f"host lane spread {s['ratio']}x exceeds the hard bound "
+            f"{SPREAD_HARD}x after {_attempt + 1} attempts at k={k} — "
+            "noise, not evidence")
+        if s["ratio"] > SPREAD_BOUND:
+            s["noisy"] = True
+        return wall, n, s
 
     # ------------------------------------------------------------------
     # North star: 10k-op CAS history over 34 independent keys.
@@ -346,26 +423,28 @@ def main():
             sf_hist.append(Op(p, "ok", "read", list(present), time=t,
                               index=t))
             t += 1
-    # median of 3: this host-side lane's wall is tens of ms, where OS
-    # noise alone is ~25% — the same honesty rule as the TPU lanes
-    # (identical inputs are fine here: no tunnel launch memoizer)
+    # this host-side lane's wall is tens of ms, where OS noise alone is
+    # ~25% — timed_host_lane applies the same honesty rule as the TPU
+    # lanes, scaling reps up on a noisy draw (identical inputs are fine
+    # here: no tunnel launch memoizer)
     n_ops = len(hist) + len(sf_hist)
-    walls = []
-    for _ in range(3):
+
+    def bank_rep(_rep):
         t0 = time.monotonic()
         bank_res = bank_wl.checker().check(test_map, hist, {})
         sf_res = checker_mod.set_full().check({}, sf_hist, {})
-        walls.append(time.monotonic() - t0)
+        wall = time.monotonic() - t0
         assert bank_res["valid"] is True, bank_res
         assert sf_res["valid"] is True, {k: sf_res[k] for k in ("valid",)}
-    walls.sort()
-    wall = walls[1]
+        return wall, n_ops
+
+    wall, _n, bspread = timed_host_lane(bank_rep)
     configs["bank-setfull"] = {
         "ops": n_ops,
         "wall_s": round(wall, 3),
         "ops_per_s": round(n_ops / wall, 1),
         "verdicts": {"true": 2, "false": 0, "unknown": 0},
-        "spread": spread_dict(n_ops / walls[-1], n_ops / walls[0], 3),
+        "spread": bspread,
     }
 
     # ------------------------------------------------------------------
@@ -405,22 +484,22 @@ def main():
 
     chk = checker_mod.linearizable(qmodel)
     chk.check({}, queue_one_build(-1)[0], {})  # warm
-    qreps = []
-    for rep in range(3):
-        hist_q, n_q = queue_one_build(rep)
+
+    def queue_one_rep(rep):
+        hist_q, nn_q = queue_one_build(rep)  # build outside the window
         t0 = time.monotonic()
         res_q = chk.check({}, hist_q, {})
-        qreps.append((time.monotonic() - t0, n_q))
+        wall = time.monotonic() - t0
         assert res_q["valid"] is True, res_q["valid"]
-    qreps.sort(key=lambda t: t[0] / t[1])
-    wall_q, n_q = qreps[len(qreps) // 2]
+        return wall, nn_q
+
+    wall_q, n_q, qspread = timed_host_lane(queue_one_rep)
     configs["queue-10k-single-pcomp"] = {
         "ops": n_q,
         "wall_s": round(wall_q, 3),
         "ops_per_s": round(n_q / wall_q, 1),
         "verdicts": {"true": 1, "false": 0, "unknown": 0},
-        "spread": spread_dict(min(nn / w for w, nn in qreps),
-                              max(nn / w for w, nn in qreps), 3),
+        "spread": qspread,
     }
     log(f"queue-10k-single-pcomp: {configs['queue-10k-single-pcomp']}")
 
@@ -632,6 +711,15 @@ def main():
         entry["winner"] = min(walls, key=walls.get)[:-3] if walls else None
         return entry
 
+    def add_resident_frac(entry):
+        """Kernel-resident fraction of the end-to-end pallas wall — the
+        dispatch pipeline's acceptance metric (ISSUE 2): whatever is
+        NOT kernel-resident is encode/pack/tunnel/sync overhead the
+        pipelined launches exist to hide."""
+        km, pm = entry.get("pallas_kernel_ms"), entry.get("pallas_ms")
+        if km and pm:
+            entry["kernel_resident_frac"] = round(km / pm, 3)
+
     crossover = {}
     for n_keys in (34, 256, 1024):
         crossover[f"valid-{n_keys}"] = backend_walls(
@@ -648,6 +736,7 @@ def main():
         crossover["deep-4096"]["pallas_kernel_ms"] = (
             pallas_kernel_resident_ms(4096, 128, 0.3, 4_000,
                                       seed=run_seed + 950))
+        add_resident_frac(crossover["deep-4096"])
     log(f"crossover deep-4096: {crossover['deep-4096']}")
     # 8k/16k lanes (VERDICT r4 item 2): the shapes where the kernel's
     # fixed dispatch+fetch round trip and the pipelined chunked pack
@@ -663,6 +752,7 @@ def main():
             crossover[f"deep-{n_keys}"]["pallas_kernel_ms"] = (
                 pallas_kernel_resident_ms(
                     n_keys, 64, 0.3, 4_000, seed=run_seed + 950 + n_keys))
+            add_resident_frac(crossover[f"deep-{n_keys}"])
             log(f"crossover deep-{n_keys}: "
                 f"{crossover[f'deep-{n_keys}']}")
     configs["tpu-vs-native"] = crossover
@@ -674,21 +764,63 @@ def main():
     for c in configs.values():
         if isinstance(c, dict) and "backend" not in c:
             c["backend"] = backend
-    print(
-        json.dumps(
-            {
-                "metric": "cas-register 10k-op history linearizability "
-                "check (34 keys, 5 clients/key, WGL kernel, "
-                + backend + ")",
-                "value": round(north_star_ops_s, 1),
-                "unit": "ops/s",
-                "backend": backend,
-                "vs_baseline": round(60.0 / elapsed, 1),
-                "cold_compile_s": round(cold, 1),
-                "configs": configs,
-            }
-        )
-    )
+    emit_summary(configs, backend, north_star_ops_s, elapsed, cold,
+                 run_seed)
+
+
+SUMMARY_MAX_BYTES = 1_500
+
+
+def emit_summary(configs, backend, north_star_ops_s, elapsed, cold,
+                 run_seed, out_dir=None) -> str:
+    """Write the full per-config dict to BENCH_FULL.json and print the
+    compact summary as the LAST stdout line (ISSUE 2): the driver's
+    tail capture truncates long stdout — the round-4 capture lost its
+    backend marker that way — so the headline must be standalone JSON
+    of at most SUMMARY_MAX_BYTES. The deep crossover lanes (walls +
+    kernel-resident fractions, the round's claim) ride along unless
+    they would blow the budget. Returns the printed line."""
+    full = {
+        "metric": "cas-register 10k-op history linearizability "
+        "check (34 keys, 5 clients/key, WGL kernel, "
+        + backend + ")",
+        "value": round(north_star_ops_s, 1),
+        "unit": "ops/s",
+        "backend": backend,
+        "vs_baseline": round(60.0 / elapsed, 1),
+        "cold_compile_s": round(cold, 1),
+        "run_seed": run_seed,
+        "configs": configs,
+    }
+    full_path = os.path.join(
+        out_dir or os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_FULL.json")
+    with open(full_path, "w") as fh:
+        json.dump(full, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    log(f"full per-config results -> {full_path}")
+    summary = {k: full[k] for k in (
+        "metric", "value", "unit", "vs_baseline", "backend",
+        "cold_compile_s", "run_seed")}
+    summary["full"] = "BENCH_FULL.json"
+    deep = {}
+    for name, entry in (configs.get("tpu-vs-native") or {}).items():
+        if not (name.startswith("deep-") and isinstance(entry, dict)):
+            continue
+        d = {k: entry[k] for k in
+             ("native_ms", "pallas_ms", "kernel_resident_frac")
+             if entry.get(k) is not None}
+        if d:
+            deep[name] = d
+    if deep:
+        summary["deep"] = deep
+    line = json.dumps(summary, separators=(",", ":"))
+    if len(line.encode()) > SUMMARY_MAX_BYTES:
+        summary.pop("deep", None)
+        line = json.dumps(summary, separators=(",", ":"))
+    assert len(line.encode()) <= SUMMARY_MAX_BYTES, len(line.encode())
+    print(line, flush=True)
+    return line
 
 
 if __name__ == "__main__":

@@ -1,0 +1,225 @@
+"""First-launch calibration of the pallas-vs-native batch routing.
+
+The r5 routing policy hard-coded ``PALLAS_BATCH_MIN = 8192`` — the lane
+count where the pallas batch engine's end-to-end wall first beat the
+C++ engine on ONE specific host (a v5e behind a ~110 ms dispatch
+tunnel).  That constant bakes host-specific dispatch latency into
+checker policy: on a TPU VM with local dispatch the crossover sits far
+lower, and behind a slower tunnel far higher.  This module measures the
+terms the crossover actually depends on, once per process, at first
+use:
+
+``t_rt``
+    the fixed dispatch+fetch round trip of one pallas launch — the
+    batch-size-independent intercept of a two-point end-to-end fit.
+``per_lane_pallas``
+    the pallas engine's marginal cost per (hard, step-capped) lane —
+    the slope of the same fit, measured through the REAL
+    ``analysis_batch`` path so it includes encode, pack, transfer and
+    kernel, not just the kernel.
+``per_lane_native``
+    the C++ engine's measured wall per identical lane at the same step
+    cap.
+
+The model: checking ``L`` hard lanes costs the native engine
+``L * per_lane_native`` (sequential, no launch cost) and the pallas
+engine ``t_rt + L * per_lane_pallas``.  The crossover is
+
+    batch_min = t_rt / (per_lane_native - per_lane_pallas)
+
+clamped to ``[CAL_MIN, CAL_MAX]``; when the denominator is not positive
+the pallas engine never catches up on this host and the threshold
+pins to ``CAL_MAX``.  Lanes are synthetic step-capped corrupt register
+histories at ``CAL_MAX_STEPS`` (the bench deep lanes' budget) — the
+shape that actually escapes native triage.
+
+``batch_min()`` returns None — and the router falls back to the
+documented ``PALLAS_BATCH_MIN`` constant — whenever measurement is
+impossible or meaningless: no real TPU backend (interpret-mode pallas
+must never preempt the C++ engine), no native toolchain to race
+against, or a failed measurement.  The result (or the failure) is
+cached per-process; ``JEPSEN_TPU_BATCH_MIN`` overrides everything for
+operators who already know their crossover.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger("jepsen_tpu.checker.calibrate")
+
+CAL_MAX_STEPS = 4_000   # step cap per calibration lane — the bench deep
+#                         lanes' budget, i.e. the measured hard-tail shape
+CAL_LANES_SMALL = 128   # one block: times t_rt + 128 lanes
+CAL_LANES_BIG = 1024    # eight blocks: the second point of the fit
+CAL_NATIVE_LANES = 16   # native is sequential; a few lanes suffice
+CAL_OPS_PER_LANE = 40   # ~48-entry lanes -> the 64-row pad bucket
+CAL_MIN = 1024          # never escalate below one thousand-ish lanes —
+#                         under that the fit's noise exceeds the signal
+CAL_MAX = 1 << 20       # "never": pallas loses at any realistic width
+
+_ENV = "JEPSEN_TPU_BATCH_MIN"
+
+_lock = threading.Lock()
+_cached = False
+_calibration: "Calibration | None" = None
+
+
+@dataclass(frozen=True)
+class Calibration:
+    """One host's measured dispatch economics (seconds)."""
+
+    t_rt: float             # fixed pallas dispatch+fetch round trip
+    per_lane_pallas: float  # marginal pallas cost per hard lane
+    per_lane_native: float  # native cost per identical lane
+
+    @property
+    def batch_min(self) -> int:
+        return derive_batch_min(
+            self.t_rt, self.per_lane_native, self.per_lane_pallas)
+
+
+def derive_batch_min(t_rt: float, per_lane_native: float,
+                     per_lane_pallas: float,
+                     lo: int = CAL_MIN, hi: int = CAL_MAX) -> int:
+    """The lane count where `t_rt + L*pallas < L*native`, clamped."""
+    margin = per_lane_native - per_lane_pallas
+    if margin <= 0:
+        return hi
+    return max(lo, min(hi, int(t_rt / margin) + 1))
+
+
+def _corrupt_register_lanes(n_lanes: int, seed: int = 0) -> list:
+    """Deterministic synthetic hard lanes: concurrent cas-register
+    histories with heavily corrupted reads.  Most refute only after a
+    deep search (or step-cap to unknown), so a step-capped run measures
+    the engines at the hard-tail shape the router actually routes —
+    the same construction as the bench's invalid-heavy/deep lanes
+    (tests/helpers.random_register_history), inlined here because the
+    package cannot depend on the test tree."""
+    from ..history import Op
+
+    lanes = []
+    for lane in range(n_lanes):
+        rng = random.Random(seed * 100_003 + lane)
+        history, t, reg, pending = [], 0, None, {}
+        started = 0
+        while started < CAL_OPS_PER_LANE or pending:
+            p = rng.randrange(4)
+            if p in pending:
+                f, value, result = pending.pop(p)
+                history.append(Op(p, "ok", f, result, time=t))
+            elif started < CAL_OPS_PER_LANE:
+                started += 1
+                if rng.random() < 0.5:
+                    f, value = "read", None
+                    result = (rng.randrange(5) if rng.random() < 0.3
+                              else reg)
+                else:
+                    f = "write"
+                    value = result = rng.randrange(5)
+                    reg = value
+                history.append(Op(p, "invoke", f, value, time=t))
+                pending[p] = (f, value, result)
+            t += 1
+        for i, o in enumerate(history):
+            o.index = i
+        lanes.append(history)
+    return lanes
+
+
+def _measure() -> Calibration | None:
+    """Run the actual measurement.  Only called on a real TPU backend
+    with a working native toolchain (gated by batch_min)."""
+    from ..history import entries as make_entries
+    from ..models import CASRegister
+    from ..models import jit as mjit
+    from ..ops import wgl_native, wgl_pallas_vec
+
+    model = CASRegister(None)
+    ess = [make_entries(h)
+           for h in _corrupt_register_lanes(CAL_LANES_BIG, seed=7)]
+    if not wgl_pallas_vec.batch_eligible(mjit.for_model(model), ess):
+        return None
+
+    def pallas_wall(lanes: int) -> float:
+        t0 = time.perf_counter()
+        wgl_pallas_vec.analysis_batch(
+            model, ess[:lanes], max_steps=CAL_MAX_STEPS)
+        return time.perf_counter() - t0
+
+    # warm the trace/compile caches so the fit measures dispatch, not
+    # the one-time Mosaic compile (which production pays anyway)
+    pallas_wall(CAL_LANES_SMALL)
+    t_small = min(pallas_wall(CAL_LANES_SMALL) for _ in range(2))
+    t_big = pallas_wall(CAL_LANES_BIG)
+    per_lane_pallas = max(
+        0.0, (t_big - t_small) / (CAL_LANES_BIG - CAL_LANES_SMALL))
+    t_rt = max(0.0, t_small - CAL_LANES_SMALL * per_lane_pallas)
+
+    t0 = time.perf_counter()
+    for es in ess[:CAL_NATIVE_LANES]:
+        wgl_native.analysis(model, es, max_steps=CAL_MAX_STEPS)
+    per_lane_native = (time.perf_counter() - t0) / CAL_NATIVE_LANES
+    return Calibration(t_rt, per_lane_pallas, per_lane_native)
+
+
+def calibration() -> Calibration | None:
+    """The per-process cached measurement (None when unavailable)."""
+    global _cached, _calibration
+    if _cached:
+        return _calibration
+    with _lock:
+        if _cached:
+            return _calibration
+        cal = None
+        try:
+            import jax
+
+            if jax.devices()[0].platform == "tpu":
+                from ..ops import wgl_native
+
+                wgl_native._get_lib()  # no native engine: nothing to
+                #                        race — constant fallback
+                cal = _measure()
+                if cal is not None:
+                    log.info(
+                        "calibrated pallas crossover: t_rt=%.1fms "
+                        "pallas=%.3fms/lane native=%.3fms/lane -> "
+                        "batch_min=%d", cal.t_rt * 1e3,
+                        cal.per_lane_pallas * 1e3,
+                        cal.per_lane_native * 1e3, cal.batch_min)
+        except Exception:  # noqa: BLE001 — calibration must never fail
+            #             a check; the constant fallback is always sound
+            log.debug("pallas crossover calibration failed", exc_info=True)
+            cal = None
+        _calibration = cal
+        _cached = True
+    return _calibration
+
+
+def batch_min() -> int | None:
+    """The measured pallas escalation threshold, or None for "use the
+    documented constant".  ``JEPSEN_TPU_BATCH_MIN`` pins it outright
+    (read per call so tests and operators can flip it live)."""
+    env = os.environ.get(_ENV)
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer %s=%r", _ENV, env)
+    cal = calibration()
+    return None if cal is None else cal.batch_min
+
+
+def _reset_for_tests() -> None:
+    """Drop the cache (test hook)."""
+    global _cached, _calibration
+    with _lock:
+        _cached = False
+        _calibration = None

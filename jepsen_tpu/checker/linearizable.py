@@ -32,7 +32,9 @@ Backends:
                 used by the independent checker): a cheap native
                 triage resolves the easy lanes, and the hard tail
                 escalates to the pallas batch kernel — the shape the
-                TPU demonstrably wins.
+                TPU demonstrably wins. The escalation bar is a
+                per-process MEASURED dispatch crossover
+                (checker/calibrate.py), not a constant.
 
 Like the reference, detailed failure artifacts are truncated (the full
 set "can take *hours*" to write, checker.clj:138-141).
@@ -85,8 +87,26 @@ TRUNCATE = 10
 # (e.g. a TPU VM without a compiler; pallas beats the pure-Python
 # fallback >10x) or when the tail is at least PALLAS_BATCH_MIN lanes
 # — the measured shape where the kernel beats the C++ engine outright.
+#
+# The escalation bar itself is MEASURED per process at first use
+# (checker/calibrate.py fits t_rt + L*per_lane_pallas vs
+# L*per_lane_native through the real engine paths and derives the
+# crossover); PALLAS_BATCH_MIN is the documented FALLBACK for hosts
+# where calibration is unavailable — no real TPU, no native toolchain
+# to race, or a failed measurement — frozen at the r5 value measured
+# on the tunnel-attached v5e. JEPSEN_TPU_BATCH_MIN overrides both.
 TRIAGE_MAX_STEPS = 2_000
 PALLAS_BATCH_MIN = 8192
+
+
+def _pallas_batch_min() -> int:
+    """The batched-auto escalation bar: the calibrated crossover when
+    the per-process measurement exists, else PALLAS_BATCH_MIN (read at
+    call time so tests and operators can repoint the module global)."""
+    from . import calibrate
+
+    bm = calibrate.batch_min()
+    return PALLAS_BATCH_MIN if bm is None else bm
 
 
 def _tpu_backend() -> bool:
@@ -356,16 +376,32 @@ class Linearizable(Checker):
 
     def _auto_results(self, model, ess, batch_kw,
                       deadline: float | None = None) -> list:
-        """The batched "auto" engine policy as raw WGLResults: native
-        triage + native finish; TPU batch engines only where no native
-        toolchain exists (policy rationale at TRIAGE_MAX_STEPS above).
-        Native availability is PER LANE — a single lane with (say) a
-        payload outside int32 must not derail the rest of the batch.
+        """The batched "auto" engine policy as raw WGLResults: batches
+        at/past the measured pallas crossover go straight to the
+        pallas engine; below it, native triage + native finish, with
+        the hard tail escalating to pallas when it clears the same bar
+        (policy rationale at TRIAGE_MAX_STEPS / _pallas_batch_min
+        above). Native availability is PER LANE — a single lane with
+        (say) a payload outside int32 must not derail the rest of the
+        batch.
         The C++ engine is stateless per call and ctypes drops the GIL
         for its duration, so on multi-core control nodes lanes fan out
         over a thread pool (the reference's bounded-pmap per-key
         checking, independent.clj:269-287)."""
         n = len(ess)
+        bm = _pallas_batch_min()
+        if n >= bm and _tpu_backend() and _pallas_eligible(model, ess):
+            # whole-batch fast route: at or past the measured crossover
+            # even the TRIAGE pass costs more wall than the pallas
+            # round trip it tries to avoid (O(n * TRIAGE_MAX_STEPS)
+            # sequential native steps — pcomp micro-lane batches land
+            # here by the thousands), and the pallas engine's own
+            # two-pass scheduler already plays the triage role
+            # in-kernel (PASS1_CAP + dense survivor repack).
+            from ..ops import wgl_pallas_vec
+
+            return list(wgl_pallas_vec.analysis_batch(
+                model, ess, **batch_kw))
         out: list = [None] * n
         try:
             from ..ops import wgl_native
@@ -409,13 +445,14 @@ class Linearizable(Checker):
         rest = [i for i in pending if not native_ok[i]]
         pallas_ok = None  # remembered when it covers `rest` exactly —
         #                   the probe is O(total ops), don't pay twice
-        if (len(hard) >= PALLAS_BATCH_MIN
+        if (len(hard) >= bm
                 and _tpu_backend()
                 and _pallas_eligible(model, [ess[i] for i in hard + rest])):
             # a hard tail this wide is the measured shape where the
-            # pallas engine beats the C++ engine END-TO-END (BENCH r5
-            # deep-16384; rationale at PALLAS_BATCH_MIN) — escalate it
-            # even though native could finish it
+            # pallas engine beats the C++ engine END-TO-END (the
+            # calibrated crossover, or BENCH r5 deep-16384 via the
+            # PALLAS_BATCH_MIN fallback) — escalate it even though
+            # native could finish it
             rest = hard + rest
             hard = []
             pallas_ok = True

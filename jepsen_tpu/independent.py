@@ -224,10 +224,15 @@ class IndependentChecker(Checker):
 
         # Batched fast path: a sub-checker exposing check_batch (the
         # linearizable checker) gets ALL per-key subhistories in one
-        # call, so its batch engines (native triage + the pallas lane
-        # kernel) see the whole key space at once instead of one
-        # launch per key. Any failure falls back to the per-key path,
-        # whose check_safe wrapper degrades per-key errors to unknown.
+        # call, so its batch engines see the whole key space at once
+        # instead of one launch per key — which is what lets the
+        # measured-crossover router (checker/calibrate.py) weigh the
+        # REAL lane count against the dispatch round trip: wide key
+        # spaces (and the pcomp micro-lanes they decompose into) clear
+        # the calibrated bar and ride the pallas pipeline whole, while
+        # narrow ones stay on native triage. Any failure falls back to
+        # the per-key path, whose check_safe wrapper degrades per-key
+        # errors to unknown.
         results = None
         if len(ks) > 1 and hasattr(self.checker, "check_batch"):
             payload = []

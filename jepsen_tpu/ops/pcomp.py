@@ -18,6 +18,14 @@ so the split is driven by the Model.components hook
   micro-op, each projected lane REWRITING to plain register ops so it
   gets the kernel encoding and rides the batched TPU path.
 
+Decomposition multiplies lane counts (one 10k-op queue history can
+become thousands of micro-lanes), which is exactly the shape the
+batched router prices: checker/linearizable groups the flattened lanes
+per sub-model and routes each group through the measured-crossover
+policy (checker/calibrate.py) — groups at or past the calibrated lane
+count go straight to the pallas dispatch pipeline, the rest through
+native triage. The decomposition itself stays engine-agnostic.
+
 Soundness notes, matching the reference's semantics exactly:
 - A crashed op that recorded no payload steps to Inconsistent in the
   model (knossos steps (dequeue, nil) to Inconsistent), so it can

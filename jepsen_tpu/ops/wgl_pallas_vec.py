@@ -65,6 +65,7 @@ test suite uses this for parity); on TPU it compiles via Mosaic.
 from __future__ import annotations
 
 import logging
+import threading
 
 import numpy as np
 
@@ -644,9 +645,27 @@ def _encode_flats(entries_list, jm, n_pad: int) -> dict:
         np.all(nil1 | ((v1_flat >= -32768) & (v1_flat < NIL16)))
         and np.all(nil2 | ((v2_flat >= -32768) & (v2_flat < NIL16))))
 
+    # Encode ONCE all the way to the packed transfer words: the meta
+    # bit-pack and the 16-bit value pack are functions of the entry
+    # alone, so computing them here turns every subsequent _layout (one
+    # per pipelined chunk, plus the two-pass survivor relaunch) into a
+    # single gather+scatter per row block — no per-chunk repacking and
+    # none of the four (n_pad, width) intermediates the old layout
+    # materialized per call.
+    cr32 = cr_flat.astype(np.int32)
+    meta_flat = (f_flat + 1) | (cr32 << 3) | (cp_flat << 4) \
+        | (rp_flat << 16)
+    if v16_fit:
+        lo = np.where(nil1, NIL16, v1_flat) & 0xFFFF
+        hi = np.where(nil2, NIL16, v2_flat) & 0xFFFF
+        v16_flat = lo | (hi << 16)
+    else:
+        v16_flat = None
+
     return {
         "f": f_flat, "v1": v1_flat, "v2": v2_flat,
-        "cr": cr_flat.astype(np.int32), "cp": cp_flat, "rp": rp_flat,
+        "cr": cr32, "cp": cp_flat, "rp": rp_flat,
+        "meta": meta_flat, "v16p": v16_flat,
         "ns": ns, "offs": offs, "v16_fit": v16_fit,
         "ncomp": np.array([es.n_completed for es in entries_list],
                           np.int32),
@@ -654,7 +673,8 @@ def _encode_flats(entries_list, jm, n_pad: int) -> dict:
 
 
 def _layout(flats: dict, idx, n_pad: int,
-            v16: bool | None = None) -> tuple[np.ndarray, int]:
+            v16: bool | None = None,
+            alloc=None) -> tuple[np.ndarray, int]:
     """Lay the lanes `idx` (None = all) out column-wise into the FEWEST
     bit-packed int32 rows. Only genuine per-entry facts cross the
     host->device boundary; the node->entry map and the initial linked
@@ -664,6 +684,13 @@ def _layout(flats: dict, idx, n_pad: int,
     (compressible), so every dropped row is milliseconds: this layout
     is 2n+1 rows vs r3's 3n+m+1 — ~2.6x fewer bytes at the deep-4096
     bench shape.
+
+    The packed words come precomputed from _encode_flats, so this is
+    ONE fill + one flat scatter per row block — no (n_pad, width)
+    intermediates. `alloc(rows, width) -> int32 buffer` lets the
+    launch pipeline supply a pooled arena buffer instead of a fresh
+    allocation per chunk; every row is overwritten, so the buffer's
+    prior contents never leak.
 
     Padding lanes have n_completed == 0, so they go VALID at init and
     idle through the block's loop. Padded ENTRIES aim their call/ret
@@ -705,46 +732,46 @@ def _layout(flats: dict, idx, n_pad: int,
     n_blocks = 1 if n_blocks <= 1 else _next_pow2(n_blocks)
     width = n_blocks * LANES
 
-    f_flat, v1_flat, v2_flat = (
-        flats["f"][sel], flats["v1"][sel], flats["v2"][sel])
-    cr_flat, cp_flat, rp_flat = (
-        flats["cr"][sel], flats["cp"][sel], flats["rp"][sel])
     ncomp = flats["ncomp"] if idx is None else flats["ncomp"][idx]
     if v16 is None:
         v16 = flats["v16_fit"]
 
-    total = len(f_flat)
+    meta_flat = flats["meta"][sel]
+    total = len(meta_flat)
     lane_idx = np.repeat(np.arange(n_lanes), ns)
     row_idx = np.arange(total) - np.repeat(np.cumsum(ns) - ns, ns)
 
     rows = (2 if v16 else 3) * n_pad + 1
-    buf = np.zeros((rows, width), np.int32)
-    cp2d = np.full((n_pad, width), m_pad - 1, np.int32)
-    rp2d = np.full((n_pad, width), m_pad - 1, np.int32)
-    f2d = np.full((n_pad, width), -1, np.int32)  # padded: never lin
-    cr2d = np.zeros((n_pad, width), np.int32)
-    cp2d[row_idx, lane_idx] = cp_flat
-    rp2d[row_idx, lane_idx] = rp_flat
-    f2d[row_idx, lane_idx] = f_flat
-    cr2d[row_idx, lane_idx] = cr_flat
-    buf[0:n_pad] = (f2d + 1) | (cr2d << 3) | (cp2d << 4) | (rp2d << 16)
-    nil1 = v1_flat == mjit.NIL32
-    nil2 = v2_flat == mjit.NIL32
+    buf = (np.empty((rows, width), np.int32) if alloc is None
+           else alloc(rows, width))
+    assert buf.shape == (rows, width) and buf.dtype == np.int32
+    # padded entries AND padding lanes share one meta word: f = -1
+    # encodes as 0, crashed 0, call/ret aimed at the trash row m_pad-1
+    mb = buf[0:n_pad]
+    mb.fill(((m_pad - 1) << 4) | ((m_pad - 1) << 16))
+    mb[row_idx, lane_idx] = meta_flat
     if v16:
         vv = buf[n_pad:2 * n_pad]
         vv.fill(NIL16 | (NIL16 << 16))  # padding entries: both NIL
-        lo = np.where(nil1, NIL16, v1_flat) & 0xFFFF
-        hi = np.where(nil2, NIL16, v2_flat) & 0xFFFF
-        vv[row_idx, lane_idx] = lo | (hi << 16)
+        v16p = flats["v16p"]
+        if v16p is None:  # caller forced v16 on a batch packed wide
+            v1_flat, v2_flat = flats["v1"][sel], flats["v2"][sel]
+            lo = np.where(v1_flat == mjit.NIL32, NIL16, v1_flat) & 0xFFFF
+            hi = np.where(v2_flat == mjit.NIL32, NIL16, v2_flat) & 0xFFFF
+            vv[row_idx, lane_idx] = lo | (hi << 16)
+        else:
+            vv[row_idx, lane_idx] = v16p[sel]
     else:
         v1 = buf[n_pad:2 * n_pad]
         v2 = buf[2 * n_pad:3 * n_pad]
         v1.fill(mjit.NIL32)
         v2.fill(mjit.NIL32)
-        v1[row_idx, lane_idx] = v1_flat
-        v2[row_idx, lane_idx] = v2_flat
+        v1[row_idx, lane_idx] = flats["v1"][sel]
+        v2[row_idx, lane_idx] = flats["v2"][sel]
 
-    buf[-1, :n_lanes] = ns.astype(np.int32) | (ncomp << 16)
+    last = buf[-1]
+    last.fill(0)
+    last[:n_lanes] = ns.astype(np.int32) | (ncomp << 16)
     return buf, n_blocks
 
 
@@ -754,6 +781,65 @@ def _pack(entries_list, jm, n_pad: int,
     split so chunked launches re-layout subsets without re-encoding)."""
     flats = _encode_flats(entries_list, jm, n_pad)
     return _layout(flats, None, n_pad, v16)
+
+
+class _HostArena:
+    """Persistent pack-buffer pool for the launch pipeline.
+
+    _layout scatters each chunk into a buffer drawn from here instead
+    of allocating (and page-faulting) rows*width*4 fresh bytes per
+    chunk — ~4 MB per chunk at the deep-16384 shape, twice per check
+    with the survivor pass, and again on every subsequent check of the
+    same shape. `depth` slots rotate per (rows, width) shape, which is
+    exactly the double-buffer discipline: chunk i+1 packs into one
+    buffer while chunk i's transfer/kernel may still be reading the
+    other, and take() re-issues a buffer only after the FENCE its last
+    launch attached has resolved. The fence is the launch's device-side
+    verdict handle: on backends where device_put aliases host memory
+    (CPU jax zero-copies numpy arrays) output readiness implies the
+    kernel is done reading the input, while on the tunnel backend the
+    input bytes were already serialized at dispatch and the fence only
+    throttles the pipeline to `depth` chunks in flight. If every slot
+    of a shape is busy (a third concurrent taker), the caller gets a
+    transient unpooled buffer rather than blocking."""
+
+    def __init__(self, depth: int = 2):
+        self.depth = depth
+        self._slots: dict = {}
+        self._lock = threading.Lock()
+
+    def take(self, rows: int, width: int):
+        """Return (buffer, slot); slot is None for transient buffers.
+        Blocks until the slot's previous launch has consumed it."""
+        key = (rows, width)
+        with self._lock:
+            slots = self._slots.setdefault(key, [])
+            slot = next((s for s in slots if not s["busy"]), None)
+            if slot is None:
+                if len(slots) >= self.depth:
+                    return np.empty((rows, width), np.int32), None
+                slot = {"buf": np.empty((rows, width), np.int32),
+                        "busy": False, "fence": None}
+                slots.append(slot)
+            slot["busy"] = True
+            fence, slot["fence"] = slot["fence"], None
+        if fence is not None:
+            try:
+                fence.block_until_ready()
+            except Exception:  # stale/errored handle: buffer is safe
+                pass
+        return slot["buf"], slot
+
+    def release(self, slot, fence) -> None:
+        """Hand a pooled buffer back, fenced by its launch's output."""
+        if slot is None:
+            return
+        with self._lock:
+            slot["fence"] = fence
+            slot["busy"] = False
+
+
+_arena = _HostArena()
 
 
 _kernel_cache: dict = {}
@@ -864,10 +950,21 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
         return small, beststack.astype(jnp.int16)
 
     if mesh is None:
-        run = jax.jit(body)
+        # the packed buffer and step row arrive as fresh host arrays
+        # and are consumed exactly once, so their device copies are
+        # donated: the unpack reuses them in place instead of holding
+        # transfer + unpacked copies live. Not under interpret — the
+        # CPU backend can't donate (and zero-copies numpy inputs, so
+        # donating would alias the host arena).
+        run = jax.jit(body,
+                      donate_argnums=() if interpret else (0, 1))
     else:
         from jax.sharding import PartitionSpec as P
-        shard_map = jax.shard_map
+        # jax.shard_map only exists on newer jax; the experimental
+        # module spans every version this repo supports
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
 
         # every input/output row block is columnwise-independent, so
         # sharding the width axis is exact; replication checking off —
@@ -893,7 +990,8 @@ def _launcher(jm, n_pad: int, interpret: bool, n_blocks: int,
 
 def analysis_batch(model, entries_list, max_steps: int | None = None,
                    interpret: bool | None = None,
-                   devices=None) -> list:
+                   devices=None,
+                   chunk_blocks: int | None = None) -> list:
     """Check a batch of independent histories, 128 lanes per kernel
     program. Raises on ineligible models/sizes — callers probe with
     `eligible` first (checker/linearizable routes here for scalar
@@ -903,7 +1001,11 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
     1-D "blocks" mesh via shard_map — each device searches its own
     share (blocks are independent), the production multi-chip path for
     the flagship engine. The driver's dryrun exercises it on a virtual
-    CPU mesh (__graft_entry__.dryrun_multichip)."""
+    CPU mesh (__graft_entry__.dryrun_multichip).
+
+    `chunk_blocks` overrides CHUNK_BLOCKS (blocks per pipelined launch
+    chunk) — production uses the default; tests shrink it to exercise
+    the chunked path at CPU-sized batches."""
     jm = mjit.for_model(model)
     if jm is None:
         raise ValueError(f"no kernel model for {model!r}")
@@ -944,28 +1046,34 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
             // 2))
     flats = _encode_flats(entries_list, jm, n_pad)
     n = len(entries_list)
+    cb = CHUNK_BLOCKS if chunk_blocks is None else max(1, int(chunk_blocks))
 
     def launch(idx, cap):
         """Launch the lanes `idx` (None = all) at step cap `cap`.
 
-        Batches wider than CHUNK_BLOCKS blocks split into chunks,
-        each packed and DISPATCHED before the first is fetched: jax
-        dispatch is async, so chunk i's kernel overlaps chunk i+1's
-        host-side layout, and the layout itself is superlinear in
-        buffer width (cache-thrashing scattered column writes — r5
-        measured a 16k-lane pack at 1.5 s in one 128-block buffer vs
-        ~0.5 s as two 64-block chunks, and end-to-end 2.0 s -> 0.8 s).
+        The pipelined dispatch path. Batches wider than `cb` blocks
+        split into chunks, each laid out into a pooled arena buffer
+        and DISPATCHED before the first is fetched: jax dispatch is
+        async, so chunk i's transfer+kernel overlaps chunk i+1's
+        host-side layout (double-buffered — the arena re-issues a
+        buffer only once its previous launch's fence resolves), and
+        the layout itself is superlinear in buffer width
+        (cache-thrashing scattered column writes — r5 measured a
+        16k-lane pack at 1.5 s in one 128-block buffer vs ~0.5 s as
+        two 64-block chunks, and end-to-end 2.0 s -> 0.8 s). The
+        verdict gather is DEFERRED: every chunk's device->host copy
+        is kicked off before any chunk is materialized, so fetches
+        stream back-to-back instead of round-tripping per chunk.
 
         Returns (small, best): small is the fetched (5, n_sel) verdict
         block; best() lazily fetches the counterexample stacks."""
-        if idx is None and (mesh is not None
-                            or n <= CHUNK_BLOCKS * LANES):
+        step = cb * LANES
+        if idx is None and (mesh is not None or n <= step):
             chunk_idx: list = [None]
         else:
             base = np.arange(n, dtype=np.int64) if idx is None \
                 else np.asarray(idx, np.int64)
-            step = CHUNK_BLOCKS * LANES
-            if mesh is not None or interpret or len(base) <= step:
+            if mesh is not None or len(base) <= step:
                 # a mesh launch stays single-shot: the mesh itself is
                 # the parallelism, and per-chunk launches would leave
                 # devices idle between dispatches
@@ -975,7 +1083,14 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
                              for i in range(0, len(base), step)]
         handles = []
         for ch in chunk_idx:
-            packed, n_blocks = _layout(flats, ch, n_pad)
+            slot_box: list = []
+
+            def alloc(rows, width, _box=slot_box):
+                buf, slot = _arena.take(rows, width)
+                _box.append(slot)
+                return buf
+
+            packed, n_blocks = _layout(flats, ch, n_pad, alloc=alloc)
             if mesh is not None and n_blocks % mesh.size:
                 # pad with empty-lane columns (n = ncomp = 0: VALID at
                 # init, idle) so every device gets whole blocks
@@ -987,7 +1102,20 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
                             cache_slots, mesh)
             msteps = np.full((1, n_blocks * LANES), cap, np.int32)
             w = n if ch is None else len(ch)
-            handles.append((run(packed, msteps), w))
+            out = run(packed, msteps)
+            # fence: the arena may re-issue this chunk's buffer only
+            # once the launch that read it has produced its verdicts
+            _arena.release(slot_box[0] if slot_box else None, out[0])
+            handles.append((out, w))
+        # deferred gather: start EVERY chunk's device->host verdict
+        # copy before materializing any — fetches stream while later
+        # chunks' kernels are still running
+        if len(handles) > 1:
+            for (small_dev, _bd), _w in handles:
+                try:
+                    small_dev.copy_to_host_async()
+                except (AttributeError, NotImplementedError):
+                    pass
         smalls, bests = [], []
         for (small_dev, best_dev), w in handles:
             # numpy fetch of the small block is the completion sync

@@ -707,9 +707,18 @@ def analysis_batch(
         batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     kernel = _kernel_for(jm, n_pad, n_state, cache_bits, unroll, dense)
-    verdicts, steps, _depths = jax.block_until_ready(kernel(batch))
-    verdicts = np.asarray(verdicts)
-    steps = np.asarray(steps)
+    verdicts_dev, steps_dev, _depths = kernel(batch)
+    # deferred gather (same discipline as wgl_pallas_vec's launch
+    # pipeline): start BOTH device->host copies before materializing
+    # either, instead of block_until_ready-ing the whole tuple and
+    # fetching serially — np.asarray below is the completion sync
+    for a in (verdicts_dev, steps_dev):
+        try:
+            a.copy_to_host_async()
+        except (AttributeError, NotImplementedError):
+            pass
+    verdicts = np.asarray(verdicts_dev)
+    steps = np.asarray(steps_dev)
 
     out: list = [None] * n_lanes
     for row, i in enumerate(row_to_lane):

@@ -1,0 +1,169 @@
+"""Measured-crossover routing (checker/calibrate): the pallas batch
+threshold derives from a first-launch measurement of the dispatch round
+trip and per-lane slopes; without a real TPU backend the router must
+fall back to the documented PALLAS_BATCH_MIN constant, and the
+JEPSEN_TPU_BATCH_MIN env var pins the threshold outright.
+
+The CPU test backend never calibrates (interpret-mode pallas must not
+preempt the C++ engine), so these tests exercise the derivation math,
+the fallback chain, and the routing integration — the measurement
+itself only runs on hardware."""
+
+import importlib
+
+import pytest
+
+from jepsen_tpu.checker import calibrate
+from jepsen_tpu.models import CASRegister
+
+lin_mod = importlib.import_module("jepsen_tpu.checker.linearizable")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    calibrate._reset_for_tests()
+    yield
+    calibrate._reset_for_tests()
+
+
+class TestDeriveBatchMin:
+    def test_crossover_math(self):
+        # t_rt 110 ms, native 85 us/lane, pallas 61 us/lane:
+        # 0.110 / 24e-6 = 4583.3 -> first integer lane count past the
+        # crossover is 4584
+        assert calibrate.derive_batch_min(0.110, 85e-6, 61e-6) == 4584
+
+    def test_nonpositive_margin_pins_to_max(self):
+        # pallas never catches up -> "never" sentinel, not a crash
+        assert calibrate.derive_batch_min(0.1, 50e-6, 50e-6) == \
+            calibrate.CAL_MAX
+        assert calibrate.derive_batch_min(0.1, 40e-6, 60e-6) == \
+            calibrate.CAL_MAX
+
+    def test_clamped_to_floor_and_ceiling(self):
+        # negligible round trip: crossover would be ~11 lanes, but the
+        # fit's noise floor holds at CAL_MIN
+        assert calibrate.derive_batch_min(1e-6, 200e-6, 100e-6) == \
+            calibrate.CAL_MIN
+        # enormous round trip vs thin margin: clamps to CAL_MAX
+        assert calibrate.derive_batch_min(3600.0, 101e-6, 100e-6) == \
+            calibrate.CAL_MAX
+
+    def test_calibration_dataclass_property(self):
+        cal = calibrate.Calibration(
+            t_rt=0.110, per_lane_pallas=61e-6, per_lane_native=85e-6)
+        assert cal.batch_min == 4584
+
+
+class TestFallbackChain:
+    def test_no_calibration_on_cpu_backend(self):
+        """The cache gates on the REAL jax platform; the CPU test
+        backend must never measure (interpret-mode pallas timings would
+        poison the routing policy)."""
+        assert calibrate.calibration() is None
+        assert calibrate.batch_min() is None
+
+    def test_router_falls_back_to_constant(self):
+        assert lin_mod._pallas_batch_min() == lin_mod.PALLAS_BATCH_MIN
+
+    def test_fallback_reads_constant_at_call_time(self, monkeypatch):
+        """Tests (and operators) monkeypatch PALLAS_BATCH_MIN; the
+        fallback must honor the live module global, not an import-time
+        copy."""
+        monkeypatch.setattr(lin_mod, "PALLAS_BATCH_MIN", 4)
+        assert lin_mod._pallas_batch_min() == 4
+
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_BATCH_MIN", "123")
+        assert calibrate.batch_min() == 123
+        assert lin_mod._pallas_batch_min() == 123
+
+    def test_env_override_floor_and_garbage(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_BATCH_MIN", "0")
+        assert calibrate.batch_min() == 1  # floored, not disabled
+        monkeypatch.setenv("JEPSEN_TPU_BATCH_MIN", "not-a-number")
+        assert calibrate.batch_min() is None  # ignored -> fallback
+        assert lin_mod._pallas_batch_min() == lin_mod.PALLAS_BATCH_MIN
+
+    def test_measured_value_routes(self, monkeypatch):
+        """When a calibration exists, its derived threshold IS the
+        router's bar."""
+        cal = calibrate.Calibration(
+            t_rt=0.02, per_lane_pallas=50e-6, per_lane_native=70e-6)
+        monkeypatch.setattr(calibrate, "calibration", lambda: cal)
+        assert lin_mod._pallas_batch_min() == cal.batch_min == 1024
+
+
+class TestSyntheticLanes:
+    def test_lanes_deterministic_and_encodable(self):
+        from jepsen_tpu.history import entries as make_entries
+        from jepsen_tpu.models import jit as mjit
+        from jepsen_tpu.ops import wgl_pallas_vec
+
+        a = calibrate._corrupt_register_lanes(4, seed=7)
+        b = calibrate._corrupt_register_lanes(4, seed=7)
+        assert [[str(o) for o in lane] for lane in a] == \
+            [[str(o) for o in lane] for lane in b]
+        ess = [make_entries(lane) for lane in a]
+        assert wgl_pallas_vec.batch_eligible(
+            mjit.for_model(CASRegister(None)), ess)
+
+
+class TestRoutingIntegration:
+    def test_calibrated_bar_routes_whole_batch_to_pallas(
+            self, monkeypatch):
+        """A measured crossover below the batch width sends the WHOLE
+        batch to the pallas engine up front — no native triage pass."""
+        from helpers import random_register_history
+
+        from jepsen_tpu import checker
+        from jepsen_tpu.history import entries as make_entries
+        from jepsen_tpu.ops import wgl_host, wgl_pallas_vec
+
+        monkeypatch.setattr(calibrate, "batch_min", lambda: 4)
+        monkeypatch.setattr(lin_mod, "_tpu_backend", lambda: True)
+        calls = []
+        real = wgl_pallas_vec.analysis_batch
+
+        def spy(model, ess, **kw):
+            calls.append(len(ess))
+            return real(model, ess, **kw)
+
+        monkeypatch.setattr(wgl_pallas_vec, "analysis_batch", spy)
+        m = CASRegister()
+        hists = [random_register_history(
+            n_process=3, n_ops=10, seed=9700 + s,
+            corrupt=0.4 if s % 3 == 0 else 0.0) for s in range(8)]
+        chk = checker.linearizable(m)
+        rs = chk.check_batch({"model": m}, [(h, {}) for h in hists])
+        assert calls and calls[0] == 8, calls
+        for h, r in zip(hists, rs):
+            want = wgl_host.analysis(m, make_entries(h)).valid
+            assert r["valid"] == want
+
+    def test_unavailable_calibration_keeps_seed_behavior(
+            self, monkeypatch):
+        """batch_min() None + narrow batch: the pallas engine must not
+        run (the seed policy, unchanged)."""
+        from helpers import random_register_history
+
+        from jepsen_tpu import checker
+        from jepsen_tpu.ops import wgl_native, wgl_pallas_vec
+
+        try:
+            wgl_native._get_lib()
+        except Exception:
+            pytest.skip("no native toolchain")
+        assert calibrate.batch_min() is None
+
+        def boom(model, ess, **kw):
+            raise AssertionError("pallas must not run below the bar")
+
+        monkeypatch.setattr(wgl_pallas_vec, "analysis_batch", boom)
+        m = CASRegister()
+        hists = [random_register_history(n_process=3, n_ops=10,
+                                         seed=9800 + s)
+                 for s in range(4)]
+        chk = checker.linearizable(m)
+        rs = chk.check_batch({"model": m}, [(h, {}) for h in hists])
+        assert all(r["valid"] is True for r in rs)

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""One-command verdict-parity replay across every available engine.
+
+Replays tests/fixtures/linearizability_corpus.jsonl — the anchored
+corpus whose expected verdicts come from independent oracles
+(brute-force enumeration / two-algorithm consensus, see
+tests/fixtures/generate_corpus.py) — through each engine that can run
+in this environment:
+
+  host        pure-Python WGL oracle (always available)
+  linear      Lowe linear engine (always available; reduced budget on
+              the 512-1024-event cases, non-contradiction required)
+  native      C++ WGL engine (skipped wholesale without a toolchain)
+  tpu         vmapped XLA while-loop kernel (batched per model)
+  pallas_vec  lane-vectorized Mosaic kernel (batched per model;
+              interpret-mode emulation on CPU)
+
+Eligibility and depth filters mirror tests/test_parity_corpus.py: the
+batched engines skip lanes the kernels can't encode, >256-event lanes
+(batch padding), and searches too deep for interpret-mode emulation —
+each skip is COUNTED, never silent. An engine may return "unknown"
+where the recorded oracle notes the other algorithm decided; it may
+never contradict the expected verdict.
+
+Writes a machine-readable summary to PARITY.json at the repo root
+(backend, interpret flag, corpus size, per-engine
+checked/matched/mismatches/skipped) and exits 0 iff no engine
+contradicted any expected verdict.
+
+Usage:  python tools/replay_parity.py  [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+CORPUS = os.path.join(ROOT, "tests", "fixtures",
+                      "linearizability_corpus.jsonl")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def load_corpus() -> list:
+    with open(CORPUS) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def models():
+    from jepsen_tpu.models import (CASRegister, FIFOQueue, MultiRegister,
+                                   Mutex, Register, UnorderedQueue)
+
+    return {
+        "cas-register": CASRegister,
+        "register": Register,
+        "mutex": Mutex,
+        "unordered-queue": UnorderedQueue,
+        "fifo-queue": FIFOQueue,
+        "multi-register": MultiRegister,
+    }
+
+
+class Tally:
+    def __init__(self, name: str):
+        self.name = name
+        self.checked = 0
+        self.matched = 0
+        self.mismatches: list = []
+        self.skipped = 0
+        self.wall_s = 0.0
+
+    def record(self, case, got, allow_unknown: bool) -> None:
+        """Score one verdict: exact match, permissible unknown, or
+        contradiction."""
+        exp = case["expected"]
+        self.checked += 1
+        ok = got == exp or (allow_unknown and got == "unknown")
+        if ok:
+            self.matched += 1
+        else:
+            self.mismatches.append(
+                {"case": case["name"], "expected": exp,
+                 "got": got if isinstance(got, (bool, str)) else str(got)})
+
+    def summary(self) -> dict:
+        return {
+            "checked": self.checked,
+            "matched": self.matched,
+            "mismatches": self.mismatches,
+            "skipped": self.skipped,
+            "wall_s": round(self.wall_s, 1),
+        }
+
+
+def replay_host(cases, MODELS) -> Tally:
+    from jepsen_tpu.history import ops as to_ops
+    from jepsen_tpu.ops import wgl_host
+
+    t = Tally("host")
+    t0 = time.monotonic()
+    for case in cases:
+        model = MODELS[case["model"]]()
+        hist = to_ops(case["history"])
+        if case["expected"] == "unknown":
+            budget = case["params"]["budget"]
+            r = wgl_host.analysis(model, hist,
+                                  max_steps=budget["max_steps"])
+            t.record(case, r.valid, allow_unknown=False)
+            continue
+        r = wgl_host.analysis(model, hist, max_steps=5_000_000)
+        # "linear" in the recorded oracle: WGL exhausted its
+        # generation-time budget and linear decided — unknown is
+        # permissible, contradiction is not.
+        t.record(case, r.valid,
+                 allow_unknown="linear" in case["oracle"])
+    t.wall_s = time.monotonic() - t0
+    return t
+
+
+def replay_linear(cases, MODELS) -> Tally:
+    from jepsen_tpu.history import ops as to_ops
+    from jepsen_tpu.ops import linear
+
+    t = Tally("linear")
+    t0 = time.monotonic()
+    for case in cases:
+        model = MODELS[case["model"]]()
+        hist = to_ops(case["history"])
+        if case["expected"] == "unknown":
+            budget = case["params"]["budget"]
+            r = linear.analysis(model, hist,
+                                max_configs=budget["max_configs"])
+            t.record(case, r.valid, allow_unknown=False)
+            continue
+        large = bool(case["params"].get("large")) or len(hist) >= 512
+        # full-budget linear on the 512-1024-event cases costs minutes
+        # per case; reduced budget + non-contradiction there (mirrors
+        # tests/test_parity_corpus.py::test_linear_parity)
+        r = linear.analysis(model, hist,
+                            max_configs=30_000 if large else 300_000)
+        t.record(case, r.valid,
+                 allow_unknown=large or "wgl" in case["oracle"])
+    t.wall_s = time.monotonic() - t0
+    return t
+
+
+def replay_native(cases, MODELS) -> Tally | None:
+    from jepsen_tpu.history import entries as make_entries, ops as to_ops
+    from jepsen_tpu.ops import wgl_native
+
+    try:
+        wgl_native._get_lib()
+    except wgl_native.NativeUnavailable as e:
+        log(f"native: unavailable ({e}); engine skipped wholesale")
+        return None
+    t = Tally("native")
+    t0 = time.monotonic()
+    for case in cases:
+        model = MODELS[case["model"]]()
+        hist = to_ops(case["history"])
+        if not wgl_native.eligible(model, make_entries(hist)):
+            t.skipped += 1
+            continue
+        if case["expected"] == "unknown":
+            budget = case["params"]["budget"]
+            r = wgl_native.analysis(model, hist,
+                                    max_steps=budget["max_steps"])
+            t.record(case, r.valid, allow_unknown=False)
+            continue
+        r = wgl_native.analysis(model, hist, max_steps=5_000_000)
+        t.record(case, r.valid,
+                 allow_unknown="linear" in case["oracle"])
+    t.wall_s = time.monotonic() - t0
+    return t
+
+
+def _batch_eligible(cases, MODELS, on_tpu: bool, *, pallas: bool):
+    """The batched engines' shared filter, mirroring
+    tests/test_parity_corpus.py: group per model, skipping (and
+    counting) lanes the kernel can't encode, >256-event lanes, and —
+    off-TPU only — searches too deep for interpret/CPU emulation."""
+    from jepsen_tpu.history import entries as make_entries, ops as to_ops
+    from jepsen_tpu.models import jit as mjit
+    from jepsen_tpu.ops import wgl_host
+
+    if pallas:
+        from jepsen_tpu.ops import wgl_pallas_vec
+
+    by_model: dict = {}
+    skipped = 0
+    # interpret-mode emulation is per-lockstep-iteration Python; the
+    # affordable search depth differs per engine (the pallas kernel
+    # pays milliseconds per iteration)
+    depth_cap = 1_200 if pallas else 30_000
+    for case in cases:
+        if case["expected"] == "unknown":
+            skipped += 1  # budgets are engine-specific
+            continue
+        model = MODELS[case["model"]]()
+        jm = mjit.for_model(model)
+        if jm is None:
+            skipped += 1
+            continue
+        es = make_entries(to_ops(case["history"]))
+        if len(es) == 0 or len(es) > 256:
+            skipped += 1
+            continue
+        if not on_tpu and wgl_host.analysis(
+                model, es, max_steps=depth_cap).valid == "unknown":
+            skipped += 1
+            continue
+        if pallas and not wgl_pallas_vec.batch_eligible(jm, [es]):
+            skipped += 1
+            continue
+        by_model.setdefault(case["model"], []).append((case, es))
+    return by_model, skipped
+
+
+def replay_tpu(cases, MODELS, on_tpu: bool) -> Tally:
+    from jepsen_tpu.ops import wgl_tpu
+
+    t = Tally("tpu")
+    by_model, t.skipped = _batch_eligible(cases, MODELS, on_tpu,
+                                          pallas=False)
+    t0 = time.monotonic()
+    for model_name, pairs in by_model.items():
+        model = MODELS[model_name]()
+        results = wgl_tpu.analysis_batch(model, [es for _, es in pairs])
+        for (case, _), r in zip(pairs, results):
+            t.record(case, r.valid, allow_unknown=False)
+    t.wall_s = time.monotonic() - t0
+    return t
+
+
+def replay_pallas(cases, MODELS, on_tpu: bool) -> Tally:
+    from jepsen_tpu.ops import wgl_pallas_vec
+
+    t = Tally("pallas_vec")
+    by_model, t.skipped = _batch_eligible(cases, MODELS, on_tpu,
+                                          pallas=True)
+    t0 = time.monotonic()
+    for model_name, pairs in by_model.items():
+        model = MODELS[model_name]()
+        results = wgl_pallas_vec.analysis_batch(
+            model, [es for _, es in pairs])
+        for (case, _), r in zip(pairs, results):
+            t.record(case, r.valid, allow_unknown=False)
+    t.wall_s = time.monotonic() - t0
+    return t
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(ROOT, "PARITY.json"),
+                    help="summary path (default: repo-root PARITY.json)")
+    args = ap.parse_args(argv)
+
+    cases = load_corpus()
+    MODELS = models()
+    log(f"corpus: {len(cases)} cases from {CORPUS}")
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    log(f"jax platform: {platform}")
+
+    engines = {}
+    for name, fn in (("host", replay_host), ("linear", replay_linear)):
+        log(f"replaying {name} ...")
+        tl = fn(cases, MODELS)
+        engines[name] = tl.summary()
+        log(f"  {name}: {engines[name]}")
+    tl = replay_native(cases, MODELS)
+    if tl is None:
+        engines["native"] = {"skipped_engine": "no C++ toolchain"}
+    else:
+        engines["native"] = tl.summary()
+        log(f"  native: {engines['native']}")
+    for name, fn in (("tpu", replay_tpu), ("pallas_vec", replay_pallas)):
+        log(f"replaying {name} ...")
+        tl = fn(cases, MODELS, on_tpu)
+        engines[name] = tl.summary()
+        log(f"  {name}: {engines[name]}")
+
+    ok = all(not e.get("mismatches") for e in engines.values())
+    out = {
+        "backend": platform,
+        "interpret": not on_tpu,  # pallas emulation mode off-TPU
+        "corpus": os.path.relpath(CORPUS, ROOT),
+        "corpus_size": len(cases),
+        "engines": engines,
+        "ok": ok,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(out, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    log(f"summary -> {args.out}")
+    print(json.dumps({"ok": ok, "backend": platform,
+                      "out": os.path.relpath(args.out, ROOT)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
