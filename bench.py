@@ -792,6 +792,12 @@ def emit_summary(configs, backend, north_star_ops_s, elapsed, cold,
         "run_seed": run_seed,
         "configs": configs,
     }
+    try:
+        from jepsen_tpu.checker import supervisor as _sup
+
+        full["supervision"] = _sup.get().telemetry.snapshot()
+    except Exception:  # noqa: BLE001 — telemetry never blocks a summary
+        pass
     full_path = os.path.join(
         out_dir or os.path.dirname(os.path.abspath(__file__)),
         "BENCH_FULL.json")
@@ -814,9 +820,20 @@ def emit_summary(configs, backend, north_star_ops_s, elapsed, cold,
             deep[name] = d
     if deep:
         summary["deep"] = deep
+    # supervision telemetry for the whole bench run (retries, demotions,
+    # breaker trips...): an all-healthy run reports {} and costs ~20
+    # bytes; a degraded run's numbers are exactly what you want in the
+    # headline when the wall-clocks look wrong
+    if "supervision" in full:
+        summary["supervision"] = {
+            k: v for k, v in full["supervision"].items()
+            if v and k not in ("calls", "per_engine")}
     line = json.dumps(summary, separators=(",", ":"))
     if len(line.encode()) > SUMMARY_MAX_BYTES:
         summary.pop("deep", None)
+        line = json.dumps(summary, separators=(",", ":"))
+    if len(line.encode()) > SUMMARY_MAX_BYTES:
+        summary.pop("supervision", None)
         line = json.dumps(summary, separators=(",", ":"))
     assert len(line.encode()) <= SUMMARY_MAX_BYTES, len(line.encode())
     print(line, flush=True)

@@ -183,7 +183,14 @@ def calibration() -> Calibration | None:
 
             if jax.devices()[0].platform == "tpu":
                 from ..ops import wgl_native
+                from . import supervisor as sup_mod
 
+                sup = sup_mod.get()
+                if not (sup.healthy("pallas") and sup.healthy("native")):
+                    # a quarantined entrant can't race fairly (or at
+                    # all) — skip to the constant fallback rather than
+                    # measure a crossover against a sick engine
+                    raise RuntimeError("engine quarantined")
                 wgl_native._get_lib()  # no native engine: nothing to
                 #                        race — constant fallback
                 cal = _measure()

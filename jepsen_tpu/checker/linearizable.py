@@ -98,6 +98,20 @@ TRUNCATE = 10
 TRIAGE_MAX_STEPS = 2_000
 PALLAS_BATCH_MIN = 8192
 
+# Explicit-algorithm degradation ladders (checker/supervisor.py): a
+# failed or quarantined engine demotes to the next rung rather than
+# aborting the check — every rung computes identical verdicts (pinned
+# by the parity corpus), so a demoted verdict is still THE verdict.
+_LADDERS = {
+    "pallas": ("pallas", "tpu", "native", "host"),
+    "tpu": ("tpu", "native", "host"),
+    "native": ("native", "host"),
+    "host": ("host",),
+    # linear is a different algorithm; its verdicts still agree, so the
+    # host WGL search is a sound floor for it too
+    "linear": ("linear", "host"),
+}
+
 
 def _pallas_batch_min() -> int:
     """The batched-auto escalation bar: the calibrated crossover when
@@ -197,6 +211,10 @@ class Linearizable(Checker):
         return m
 
     def check(self, test, history, opts=None) -> dict:
+        from . import supervisor as sup_mod
+
+        sup = sup_mod.get()
+        snap0 = sup.telemetry.snapshot()
         model = self._model(test)
         history = list(history)  # may be a one-shot iterator; used twice
         es = make_entries(history)
@@ -217,45 +235,72 @@ class Linearizable(Checker):
                         lanes, self._steps_budget(),
                         deadline=self._deadline())
                     d = self._result(_combine_lanes(rs))
+                    self._attach_supervision(d, sup, snap0)
                     self._render_invalid(test, history, d, opts)
                     return d
             # for ONE history the sequential C++ engine wins outright:
             # a TPU kernel launch costs more than most whole searches,
             # and a single lane can't amortize it (BENCH_r03
             # tpu-vs-native). The TPU earns its keep in check_batch.
-            if _native_available(model, es):
+            # A quarantined native engine is skipped outright — the
+            # ladder below would demote anyway, but not attempting it
+            # is the breaker's whole point.
+            if sup.healthy("native") and _native_available(model, es):
                 algorithm = "native"
             elif _tpu_eligible(model, es):
                 algorithm = "tpu"
             else:
                 algorithm = "host"
 
-        if algorithm == "host":
-            r = wgl_host.analysis(model, es, time_limit=self.time_limit)
-        elif algorithm == "native":
-            from ..ops import wgl_native
-
-            r = wgl_native.analysis(model, es,
-                                    time_limit=self.time_limit)
-        elif algorithm == "linear":
-            r = linear_mod.analysis(model, es, time_limit=self.time_limit)
-        elif algorithm == "tpu":
-            from ..ops import wgl_tpu
-
-            r = wgl_tpu.analysis(model, es, time_limit=self.time_limit)
-        elif algorithm == "pallas":
-            from ..ops import wgl_pallas_vec
-
-            (r,) = wgl_pallas_vec.analysis_batch(model, [es])
+        if algorithm in _LADDERS:
+            # supervised: deadline watchdog + retry/backoff + breaker +
+            # demotion down the ladder; check_safe (the caller's
+            # wrapper) still turns a fully-exhausted ladder into an
+            # unknown verdict
+            (r,) = sup.run(
+                model, [es], time_limit=self.time_limit,
+                ladder=_LADDERS[algorithm],
+                deadline=self._watchdog(sup), on_exhausted="raise")
         elif algorithm == "competition":
             d = self._competition(model, es)
+            self._attach_supervision(d, sup, snap0)
             self._render_invalid(test, history, d, opts)
             return d
         else:
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
         d = self._result(r)
+        self._attach_supervision(d, sup, snap0)
         self._render_invalid(test, history, d, opts)
         return d
+
+    @staticmethod
+    def _attach_supervision(d, sup, snap0) -> None:
+        """Attach the supervision telemetry this check generated
+        (retries, demotions, breaker trips, salvaged chunks...) when
+        any fired — a plain healthy call count is not an event and
+        stays off the result. Counters are process-global, so
+        concurrent checkers may cross-attribute — the field is
+        observability, not an invariant."""
+        from . import supervisor as sup_mod
+
+        delta = sup_mod.Telemetry.delta(snap0, sup.telemetry.snapshot())
+        if any(k != "calls" for k in delta):
+            d["supervision"] = delta
+
+    def _watchdog(self, sup) -> float | None:
+        """The wall-clock watchdog deadline for one supervised engine
+        call: generous slack over time_limit (the engines enforce the
+        budget themselves — the watchdog only catches calls that wedge
+        BEFORE the kernel can count steps, e.g. a hung compile).
+        Without a time_limit the supervisor's call_timeout (if any)
+        applies instead."""
+        import time as _t
+
+        if self.time_limit is None:
+            return None
+        c = sup.config
+        return (_t.monotonic() + self.time_limit * c.deadline_slack
+                + c.deadline_grace)
 
     def check_batch(self, test, items) -> list[dict]:
         """Check many independent histories in one pass — the batched
@@ -271,6 +316,10 @@ class Linearizable(Checker):
         launch cost amortizes across exactly that shape (measured
         ~3x native wall-clock on 4k-lane refutation-heavy batches,
         BENCH_r03 tpu-vs-native)."""
+        from . import supervisor as sup_mod
+
+        sup = sup_mod.get()
+        snap0 = sup.telemetry.snapshot()
         opts_list = [o for _, o in items]
         histories = [list(h) for h, _ in items]
         model = self._model(test)
@@ -283,21 +332,30 @@ class Linearizable(Checker):
             self._render_invalid(test, histories[i], d, opts_list[i])
             results[i] = d
 
+        def attach_all():
+            """One shared telemetry dict on every item of the batch (it
+            was ONE supervised pass — per-item attribution would be
+            fiction); independent.py dedups by object identity. A plain
+            healthy call count is not an event and stays off."""
+            delta = sup_mod.Telemetry.delta(
+                snap0, sup.telemetry.snapshot())
+            if any(k != "calls" for k in delta):
+                for d in results:
+                    if d is not None:
+                        d["supervision"] = delta
+
         algorithm = self.algorithm
         batch_kw = self._steps_budget()
-        if algorithm == "pallas":
-            from ..ops import wgl_pallas_vec
-
-            for i, r in enumerate(
-                    wgl_pallas_vec.analysis_batch(model, ess, **batch_kw)):
+        if algorithm in ("pallas", "tpu"):
+            # supervised batch: a mid-batch engine failure demotes the
+            # affected chunk down the ladder and salvages the rest —
+            # never aborts the whole batch (on_exhausted="unknown")
+            for i, r in enumerate(sup.run(
+                    model, ess, ladder=_LADDERS[algorithm],
+                    deadline=self._watchdog(sup),
+                    on_exhausted="unknown", **batch_kw)):
                 finish(i, r)
-            return results
-        if algorithm == "tpu":
-            from ..ops import wgl_tpu
-
-            for i, r in enumerate(
-                    wgl_tpu.analysis_batch(model, ess, **batch_kw)):
-                finish(i, r)
+            attach_all()
             return results
         if algorithm != "auto":
             # host/native/linear/competition: per-lane, same as check()
@@ -328,10 +386,12 @@ class Linearizable(Checker):
                                              deadline=self._deadline())
                 for i, (a, b) in enumerate(spans):
                     finish(i, _combine_lanes(rs[a:b]))
+                attach_all()
                 return results
 
         for i, r in enumerate(self._auto_results(model, ess, batch_kw)):
             finish(i, r)
+        attach_all()
         return results
 
     def _steps_budget(self) -> dict:
@@ -388,9 +448,20 @@ class Linearizable(Checker):
         for its duration, so on multi-core control nodes lanes fan out
         over a thread pool (the reference's bounded-pmap per-key
         checking, independent.clj:269-287)."""
+        from . import supervisor as sup_mod
+
+        sup = sup_mod.get()
         n = len(ess)
         bm = _pallas_batch_min()
-        if n >= bm and _tpu_backend() and _pallas_eligible(model, ess):
+        # watchdog for supervised calls: the shared deadline plus grace
+        # (the engines honor the deadline themselves via budgets; the
+        # watchdog only catches calls wedged before they can count)
+        import time as _t
+
+        wd = (None if deadline is None
+              else deadline + sup.config.deadline_grace)
+        if (n >= bm and _tpu_backend() and sup.healthy("pallas")
+                and _pallas_eligible(model, ess)):
             # whole-batch fast route: at or past the measured crossover
             # even the TRIAGE pass costs more wall than the pallas
             # round trip it tries to avoid (O(n * TRIAGE_MAX_STEPS)
@@ -398,18 +469,21 @@ class Linearizable(Checker):
             # here by the thousands), and the pallas engine's own
             # two-pass scheduler already plays the triage role
             # in-kernel (PASS1_CAP + dense survivor repack).
-            from ..ops import wgl_pallas_vec
-
-            return list(wgl_pallas_vec.analysis_batch(
-                model, ess, **batch_kw))
+            return list(sup.run(
+                model, ess, ladder=_LADDERS["pallas"], deadline=wd,
+                on_exhausted="unknown", **batch_kw))
         out: list = [None] * n
-        try:
-            from ..ops import wgl_native
-
-            wgl_native._get_lib()
-            native_ok = [wgl_native.eligible(model, es) for es in ess]
-        except Exception:  # noqa: BLE001 — no toolchain / build failure
+        if not sup.healthy("native"):
+            # quarantined by the breaker: route around it entirely
             native_ok = [False] * n
+        else:
+            try:
+                from ..ops import wgl_native
+
+                wgl_native._get_lib()
+                native_ok = [wgl_native.eligible(model, es) for es in ess]
+            except Exception:  # noqa: BLE001 — no toolchain / build
+                native_ok = [False] * n
 
         def native_map(idxs, fn):
             """[(i, WGLResult)] for idxs, pooled when it can help."""
@@ -421,18 +495,23 @@ class Linearizable(Checker):
                     return list(zip(idxs, pool.map(fn, idxs)))
             return [(i, fn(i)) for i in idxs]
 
+        def triage_one(i):
+            """None means 'triage itself failed' — the lane is not
+            resolved AND the native engine takes a health strike."""
+            try:
+                return wgl_native.analysis(
+                    model, ess[i], max_steps=TRIAGE_MAX_STEPS)
+            except Exception as e:  # noqa: BLE001
+                sup.note_failure("native", e)
+                return None
+
         triage = [i for i in range(n) if native_ok[i]]
         pending = [i for i in range(n) if not native_ok[i]]
-        for i, r in native_map(
-                triage,
-                lambda i: wgl_native.analysis(
-                    model, ess[i], max_steps=TRIAGE_MAX_STEPS)):
-            if r.valid == "unknown":
+        for i, r in native_map(triage, triage_one):
+            if r is None or r.valid == "unknown":
                 pending.append(i)
             else:
                 out[i] = r
-
-        import time as _t
 
         def lane_limit():
             """Per-lane wall limit: the shared deadline's remainder
@@ -447,6 +526,7 @@ class Linearizable(Checker):
         #                   the probe is O(total ops), don't pay twice
         if (len(hard) >= bm
                 and _tpu_backend()
+                and sup.healthy("pallas")
                 and _pallas_eligible(model, [ess[i] for i in hard + rest])):
             # a hard tail this wide is the measured shape where the
             # pallas engine beats the C++ engine END-TO-END (the
@@ -456,33 +536,33 @@ class Linearizable(Checker):
             rest = hard + rest
             hard = []
             pallas_ok = True
-        for i, r in native_map(
-                hard,
-                lambda i: wgl_native.analysis(
-                    model, ess[i], time_limit=lane_limit())):
-            out[i] = r
+        if hard:
+            # supervised native finish (wgl_native.analysis_batch pools
+            # the lanes internally, same fan-out as the old native_map)
+            for i, r in zip(hard, sup.run(
+                    model, [ess[i] for i in hard],
+                    time_limit=lane_limit(), ladder=("native", "host"),
+                    deadline=wd, on_exhausted="unknown")):
+                out[i] = r
         if rest:
             sub = [ess[i] for i in rest]
             if pallas_ok is None:
-                pallas_ok = _pallas_eligible(model, sub)
+                pallas_ok = (sup.healthy("pallas")
+                             and _pallas_eligible(model, sub))
             if pallas_ok:
-                from ..ops import wgl_pallas_vec
-
-                for i, r in zip(rest,
-                                wgl_pallas_vec.analysis_batch(
-                                    model, sub, **batch_kw)):
-                    out[i] = r
+                rs = sup.run(model, sub, ladder=("pallas", "tpu", "host"),
+                             deadline=wd, on_exhausted="unknown",
+                             **batch_kw)
             elif all(_tpu_eligible(model, es) for es in sub):
-                from ..ops import wgl_tpu
-
-                for i, r in zip(rest,
-                                wgl_tpu.analysis_batch(model, sub,
-                                                       **batch_kw)):
-                    out[i] = r
+                rs = sup.run(model, sub, ladder=("tpu", "host"),
+                             deadline=wd, on_exhausted="unknown",
+                             **batch_kw)
             else:
-                for i in rest:
-                    out[i] = wgl_host.analysis(
-                        model, ess[i], time_limit=lane_limit())
+                rs = sup.run(model, sub, ladder=("host",),
+                             time_limit=lane_limit(), deadline=wd,
+                             on_exhausted="unknown")
+            for i, r in zip(rest, rs):
+                out[i] = r
         return out
 
     @staticmethod

@@ -1,0 +1,705 @@
+"""Supervised engine dispatch: deadlines, retry/backoff, circuit
+breakers, and the degradation ladder for the checker fleet.
+
+Jepsen's premise is that the *harness* survives the faults it injects,
+yet the batch engines it leans on — pallas/Mosaic kernels, the XLA
+while-loop kernel, a ctypes C++ library — all sit in front of hardware
+and toolchains that fail in practice: device OOM, a wedged first
+compile, a TPU preemption mid-batch, a missing g++. Before this module
+any such failure aborted the whole analysis. Now every engine call the
+linearizable checker makes routes through a Supervisor that gives it:
+
+deadline
+    a wall-clock bound enforced by a watchdog thread ON TOP of the
+    engines' own step budgets (a while-loop kernel can't consult the
+    wall clock; a wedged XLA compile never reaches the kernel at all).
+    A timed-out call is abandoned (the worker thread parks on the
+    atexit drain — a daemon thread killed mid-XLA-compile aborts the
+    interpreter) and counts as an engine failure.
+
+retry
+    capped exponential backoff with seeded jitter for transient
+    failures, plus adaptive bisection on device OOM: the chunk splits
+    in half and the halves retry — a batch one lane too wide for HBM
+    degrades into two launches instead of an abort.
+
+circuit breaker
+    K consecutive failures quarantine an engine for a cool-down;
+    quarantined engines are skipped by the ladder AND by the batch
+    routing / calibration in checker/linearizable.py and
+    checker/calibrate.py, so a dead backend stops eating a retry
+    storm per batch.
+
+degradation ladder
+    pallas → tpu → native → host. Every rung computes the same
+    verdicts (ops/pcomp + the parity corpus pin this); a failed or
+    quarantined rung demotes its chunks to the next one. Chunks that
+    already completed keep their verdicts ("salvage") — one engine
+    failure never costs more than one chunk of lanes, the same
+    locality argument P-compositionality gives the checker itself.
+
+first-compile probe
+    a FATAL XLA abort (the Mosaic compiler can take the process down,
+    see checker/linearizable.py's racer drain) is contained by probing
+    an engine's first compile in a SUBPROCESS; a dead probe merely
+    trips the breaker.
+
+Telemetry (retries, demotions, breaker trips, salvaged chunks,
+timeouts, bisections) is counted per process and surfaced as a
+`supervision` field in checker results and the bench summary line.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger("jepsen_tpu.checker.supervisor")
+
+#: The degradation ladder, best rung first. Every rung returns
+#: WGLResults with identical verdict semantics.
+LADDER = ("pallas", "tpu", "native", "host")
+
+#: Telemetry counter names (fixed so snapshots/deltas are total).
+COUNTERS = (
+    "calls", "retries", "demotions", "breaker_trips", "salvaged_chunks",
+    "timeouts", "bisections", "engine_failures", "probe_failures",
+    "exhausted",
+)
+
+# Threads abandoned by watchdog timeouts: same discipline as the
+# competition racers in checker/linearizable.py — a daemon thread
+# killed mid-XLA-compile aborts the interpreter, so join them (bounded)
+# at exit.
+_abandoned: list = []
+
+
+@atexit.register
+def _drain_abandoned():
+    deadline = time.monotonic() + 120
+    for t in _abandoned:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
+class EngineFailure(Exception):
+    """An engine call failed after supervision gave up on it.
+
+    kind is the final classification: "oom", "timeout", "transient",
+    "unavailable", or "fatal"."""
+
+    def __init__(self, engine: str, kind: str, cause=None):
+        super().__init__(f"{engine} failed ({kind}): {cause}")
+        self.engine = engine
+        self.kind = kind
+        self.cause = cause
+
+
+class EngineTimeout(Exception):
+    """Internal marker: the watchdog expired before the call returned."""
+
+
+#: substrings that mark an allocation failure on any backend (jaxlib's
+#: XlaRuntimeError renders RESOURCE_EXHAUSTED; interpret mode and the
+#: native engine raise MemoryError).
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory",
+                "OOM", "Attempting to allocate")
+
+#: substrings that mark "this engine cannot take this work at all" —
+#: not a health event: demote immediately, no retry, no breaker count.
+_UNAVAILABLE_MARKERS = ("no int32 encoding", "no kernel model",
+                        "no native encoding", "ineligible")
+
+
+def classify_error(e: BaseException) -> str:
+    """Map an engine exception to a retry class: "oom" (bisect then
+    retry), "timeout" (retry), "unavailable" (demote, not a health
+    event), or "transient" (retry)."""
+    if isinstance(e, EngineTimeout):
+        return "timeout"
+    if isinstance(e, MemoryError):
+        return "oom"
+    try:
+        from ..ops import wgl_native
+
+        if isinstance(e, wgl_native.NativeUnavailable):
+            return "unavailable"
+    except ImportError:
+        pass
+    if isinstance(e, ImportError):
+        return "unavailable"
+    text = f"{type(e).__name__}: {e}"
+    if any(m in text for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in text for m in _UNAVAILABLE_MARKERS):
+        return "unavailable"
+    return "transient"
+
+
+@dataclass
+class SupervisorConfig:
+    """Policy knobs. The defaults are inert on the happy path: no
+    watchdog thread unless a deadline exists, no sleeps unless a call
+    fails, no subprocess unless probing is enabled."""
+
+    call_timeout: float | None = None  # wall bound per engine call
+    #: watchdog slack applied when the CHECKER's time_limit implies a
+    #: deadline: engines translate time_limit to step budgets that can
+    #: legitimately overshoot (compile time, launch queues), so the
+    #: watchdog fires only well past the budget — it exists to catch
+    #: wedged calls, not slow ones.
+    deadline_slack: float = 4.0
+    deadline_grace: float = 60.0
+    max_retries: int = 2               # per engine rung, per chunk
+    backoff_base: float = 0.05         # seconds; doubles per attempt
+    backoff_cap: float = 2.0
+    breaker_threshold: int = 3         # K consecutive failures -> open
+    breaker_cooldown: float = 30.0     # seconds quarantined
+    bisect_min: int = 64               # don't split below this many lanes
+    chunk_lanes: int = 8192            # supervision (salvage) granularity
+    seed: int = 0                      # backoff jitter rng
+    probe_first_compile: bool = False  # subprocess-probe pallas/tpu
+    probe_timeout: float = 180.0
+
+
+class Telemetry:
+    """Monotone per-process counters, snapshot/delta-able."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {k: 0 for k in COUNTERS}
+        self.per_engine: dict = {}  # engine -> {kind: count}
+
+    def record(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[counter] += n
+
+    def record_engine_failure(self, engine: str, kind: str) -> None:
+        with self._lock:
+            self._c["engine_failures"] += 1
+            d = self.per_engine.setdefault(engine, {})
+            d[kind] = d.get(kind, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._c)
+            out["per_engine"] = {k: dict(v)
+                                 for k, v in self.per_engine.items()}
+            return out
+
+    @staticmethod
+    def delta(before: dict, after: dict) -> dict:
+        """after - before, dropping zero counters (and per_engine when
+        nothing failed) so quiet calls attach nothing."""
+        out = {k: after[k] - before[k] for k in COUNTERS
+               if after[k] - before[k]}
+        pe = {}
+        for eng, kinds in after.get("per_engine", {}).items():
+            b = before.get("per_engine", {}).get(eng, {})
+            d = {k: v - b.get(k, 0) for k, v in kinds.items()
+                 if v - b.get(k, 0)}
+            if d:
+                pe[eng] = d
+        if pe:
+            out["per_engine"] = pe
+        return out
+
+
+class CircuitBreaker:
+    """Per-engine consecutive-failure breaker with cool-down."""
+
+    def __init__(self, threshold: int, cooldown: float, clock=time.monotonic):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._consec: dict[str, int] = {}
+        self._open_until: dict[str, float] = {}
+
+    def healthy(self, engine: str) -> bool:
+        with self._lock:
+            until = self._open_until.get(engine)
+            if until is None:
+                return True
+            if self.clock() >= until:
+                # half-open: allow one attempt; a failure re-trips
+                del self._open_until[engine]
+                return True
+            return False
+
+    def record_success(self, engine: str) -> None:
+        with self._lock:
+            self._consec[engine] = 0
+            self._open_until.pop(engine, None)
+
+    def record_failure(self, engine: str) -> bool:
+        """Count a failure; returns True when this one TRIPS the
+        breaker (closed -> open)."""
+        with self._lock:
+            n = self._consec.get(engine, 0) + 1
+            self._consec[engine] = n
+            if n >= self.threshold and engine not in self._open_until:
+                self._open_until[engine] = self.clock() + self.cooldown
+                return True
+            return False
+
+    def trip(self, engine: str, cooldown: float | None = None) -> None:
+        """Force-quarantine (used by the first-compile probe)."""
+        with self._lock:
+            self._consec[engine] = max(
+                self.threshold, self._consec.get(engine, 0))
+            self._open_until[engine] = self.clock() + (
+                self.cooldown if cooldown is None else cooldown)
+
+    def state(self) -> dict:
+        with self._lock:
+            now = self.clock()
+            return {e: round(t - now, 1)
+                    for e, t in self._open_until.items() if t > now}
+
+
+# ---------------------------------------------------------------------------
+# Default engine runners & eligibility — one uniform signature:
+#   run(model, ess, max_steps=None, time_limit=None) -> list[WGLResult]
+
+def _steps_for(time_limit):
+    """time_limit -> a conservative step budget for budget-only engines
+    (the wgl_tpu.analysis translation)."""
+    from ..ops import wgl_tpu
+
+    return max(1000, int(time_limit * wgl_tpu.STEPS_PER_SEC_ESTIMATE))
+
+
+def _run_pallas(model, ess, max_steps=None, time_limit=None):
+    from ..ops import wgl_pallas_vec
+
+    if max_steps is None and time_limit is not None:
+        max_steps = _steps_for(time_limit)
+    return list(wgl_pallas_vec.analysis_batch(model, ess,
+                                              max_steps=max_steps))
+
+
+def _run_tpu(model, ess, max_steps=None, time_limit=None):
+    from ..ops import wgl_tpu
+
+    if max_steps is None and time_limit is not None:
+        max_steps = _steps_for(time_limit)
+    kw = {} if max_steps is None else {"max_steps": max_steps}
+    return list(wgl_tpu.analysis_batch(model, ess, **kw))
+
+
+def _run_native(model, ess, max_steps=None, time_limit=None):
+    from ..ops import wgl_native
+
+    return wgl_native.analysis_batch(model, ess, max_steps=max_steps,
+                                     time_limit=time_limit)
+
+
+def _run_host(model, ess, max_steps=None, time_limit=None):
+    from ..ops import wgl_host
+
+    return [wgl_host.analysis(model, es, max_steps=max_steps,
+                              time_limit=time_limit) for es in ess]
+
+
+def _run_linear(model, ess, max_steps=None, time_limit=None):
+    from ..ops import linear as linear_mod
+
+    return [linear_mod.analysis(model, es, time_limit=time_limit)
+            for es in ess]
+
+
+def default_registry() -> dict:
+    return {
+        "pallas": _run_pallas,
+        "tpu": _run_tpu,
+        "native": _run_native,
+        "host": _run_host,
+        "linear": _run_linear,
+    }
+
+
+def _elig_pallas(model, ess) -> bool:
+    from ..models import jit as mjit
+
+    try:
+        from ..ops import wgl_pallas_vec
+    except ImportError:
+        return False
+    jm = mjit.for_model(model)
+    return jm is not None and wgl_pallas_vec.batch_eligible(jm, ess)
+
+
+def _elig_tpu(model, ess) -> bool:
+    from ..models import jit as mjit
+
+    try:
+        from ..ops import wgl_tpu  # noqa: F401
+    except ImportError:
+        return False
+    jm = mjit.for_model(model)
+    return jm is not None and all(jm.lane_eligible(es) for es in ess)
+
+
+def _elig_native(model, ess) -> bool:
+    try:
+        from ..ops import wgl_native
+
+        wgl_native._get_lib()
+        return all(wgl_native.eligible(model, es) for es in ess)
+    except Exception:  # noqa: BLE001 — no toolchain / build failure
+        return False
+
+
+def default_eligibility() -> dict:
+    return {
+        "pallas": _elig_pallas,
+        "tpu": _elig_tpu,
+        "native": _elig_native,
+        "host": lambda model, ess: True,
+        "linear": lambda model, ess: True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The supervisor
+
+class Supervisor:
+    """Fault-tolerant front end over the engine registry. One instance
+    per process in production (get()); tests build their own with a
+    faulty registry and a tiny config."""
+
+    def __init__(self, config: SupervisorConfig | None = None,
+                 registry: dict | None = None,
+                 eligibility: dict | None = None,
+                 clock=time.monotonic):
+        self.config = config or SupervisorConfig()
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.eligibility = eligibility if eligibility is not None \
+            else default_eligibility()
+        self.telemetry = Telemetry()
+        self.breaker = CircuitBreaker(self.config.breaker_threshold,
+                                      self.config.breaker_cooldown,
+                                      clock=clock)
+        self._rng = random.Random(self.config.seed)
+        self._rng_lock = threading.Lock()
+        self._probed: dict[str, bool] = {}
+        self._probe_lock = threading.Lock()
+
+    # -- health -----------------------------------------------------------
+
+    def healthy(self, engine: str) -> bool:
+        """Routing hook: is this engine currently worth attempting?
+        Consulted by checker/linearizable's batch routing and by
+        checker/calibrate before measuring."""
+        return self.breaker.healthy(engine)
+
+    def note_failure(self, engine: str, e: BaseException) -> None:
+        """Record an engine failure observed OUTSIDE a supervised call
+        (e.g. the native triage loop) so the breaker still learns."""
+        kind = classify_error(e)
+        if kind == "unavailable":
+            return
+        self.telemetry.record_engine_failure(engine, kind)
+        if self.breaker.record_failure(engine):
+            self.telemetry.record("breaker_trips")
+            log.warning("circuit breaker tripped for %s (%s)", engine, e)
+
+    # -- single supervised call ------------------------------------------
+
+    def _sleep_backoff(self, attempt: int) -> None:
+        c = self.config
+        with self._rng_lock:
+            jitter = 0.5 + self._rng.random()  # [0.5, 1.5)
+        time.sleep(min(c.backoff_cap,
+                       c.backoff_base * (2 ** attempt)) * jitter)
+
+    def _bounded(self, fn, engine: str, deadline: float | None):
+        """Run fn(), bounded by the watchdog deadline when one exists.
+        Timeout abandons the worker thread (atexit-drained) and raises
+        EngineTimeout."""
+        if deadline is None:
+            return fn()
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise EngineTimeout(f"{engine}: deadline already expired")
+        box: dict = {}
+        done = threading.Event()
+
+        def worker():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            done.set()
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name=f"jepsen supervised {engine}")
+        t.start()
+        if not done.wait(remaining):
+            _abandoned.append(t)
+            self.telemetry.record("timeouts")
+            raise EngineTimeout(
+                f"{engine}: no verdict within {remaining:.1f}s")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def call(self, engine: str, model, ess, max_steps=None,
+             time_limit=None, deadline: float | None = None) -> list:
+        """One supervised engine call over `ess`: deadline + retries +
+        OOM bisection. Success resets the breaker; exhaustion raises
+        EngineFailure (callers demote). Results align with `ess`."""
+        run = self.registry[engine]
+        c = self.config
+        if deadline is None and c.call_timeout is not None:
+            deadline = time.monotonic() + c.call_timeout
+        last = None
+        kind = "transient"
+        for attempt in range(c.max_retries + 1):
+            if attempt:
+                self.telemetry.record("retries")
+                self._sleep_backoff(attempt - 1)
+            self.telemetry.record("calls")
+            try:
+                rs = self._bounded(
+                    lambda: run(model, ess, max_steps=max_steps,
+                                time_limit=time_limit),
+                    engine, deadline)
+                if len(rs) != len(ess):
+                    raise RuntimeError(
+                        f"{engine} returned {len(rs)} results for "
+                        f"{len(ess)} lanes")
+                self.breaker.record_success(engine)
+                return rs
+            except Exception as e:  # noqa: BLE001 — KeyboardInterrupt
+                #                     and SystemExit still propagate
+                last, kind = e, classify_error(e)
+                if kind == "unavailable":
+                    # not a health event: the engine can't take this
+                    # work at all — demote without burning retries
+                    raise EngineFailure(engine, kind, e) from e
+                self.telemetry.record_engine_failure(engine, kind)
+                if self.breaker.record_failure(engine):
+                    self.telemetry.record("breaker_trips")
+                    log.warning("circuit breaker tripped for %s (%s)",
+                                engine, e)
+                log.warning("%s failed (%s, attempt %d/%d): %s", engine,
+                            kind, attempt + 1, c.max_retries + 1, e)
+                if kind == "oom" and len(ess) >= 2 * c.bisect_min:
+                    # adaptive bisection: halve the chunk and run the
+                    # halves (each under its own retry budget) — the
+                    # recursive floor is bisect_min
+                    self.telemetry.record("bisections")
+                    mid = len(ess) // 2
+                    return (self.call(engine, model, ess[:mid],
+                                      max_steps=max_steps,
+                                      time_limit=time_limit,
+                                      deadline=deadline)
+                            + self.call(engine, model, ess[mid:],
+                                        max_steps=max_steps,
+                                        time_limit=time_limit,
+                                        deadline=deadline))
+                if not self.breaker.healthy(engine):
+                    break  # quarantined mid-loop: stop hammering it
+        raise EngineFailure(engine, kind, last) from last
+
+    # -- the ladder -------------------------------------------------------
+
+    def _rungs(self, ladder, model, ess) -> list:
+        """The ladder filtered to registered engines; host is always
+        appended as the floor so the ladder can't be empty."""
+        rungs = [r for r in ladder if r in self.registry]
+        if "host" in self.registry and "host" not in rungs:
+            rungs.append("host")
+        return rungs
+
+    def run(self, model, ess, max_steps=None, time_limit=None,
+            ladder=LADDER, deadline: float | None = None,
+            on_exhausted: str = "unknown") -> list:
+        """Run a batch down the degradation ladder in supervision
+        chunks. Each chunk starts at the first healthy+eligible rung
+        and demotes on failure; completed chunks keep their verdicts
+        (salvage). `on_exhausted` decides what happens when a chunk
+        falls off the ladder: "unknown" (never abort a batch — the
+        auto policy) or "raise" (explicit-algorithm checks, where
+        check_safe turns the error into an unknown verdict)."""
+        from ..ops import wgl_host
+
+        n = len(ess)
+        if n == 0:
+            return []
+        step = max(1, self.config.chunk_lanes)
+        chunks = [list(range(i, min(i + step, n)))
+                  for i in range(0, n, step)]
+        out: list = [None] * n
+        any_demotion = False
+        clean_chunks = 0
+        for chunk in chunks:
+            sub = [ess[i] for i in chunk]
+            rs = None
+            demoted_here = 0
+            last_err: EngineFailure | None = None
+            for rung in self._rungs(ladder, model, sub):
+                if not self.breaker.healthy(rung):
+                    # quarantined: demote WITHOUT attempting (the
+                    # breaker's whole point); doesn't count as a
+                    # demotion unless it changes the outcome rung
+                    demoted_here += 1
+                    continue
+                elig = self.eligibility.get(rung)
+                if elig is not None and not elig(model, sub):
+                    demoted_here += 1
+                    continue
+                if (rung in ("pallas", "tpu")
+                        and self.config.probe_first_compile
+                        and not self.probe_engine(rung)):
+                    # first compile died in the probe subprocess — the
+                    # breaker is tripped; fall through a rung
+                    demoted_here += 1
+                    continue
+                try:
+                    rs = self.call(rung, model, sub, max_steps=max_steps,
+                                   time_limit=time_limit,
+                                   deadline=deadline)
+                    break
+                except EngineFailure as e:
+                    last_err = e
+                    demoted_here += 1
+                    log.warning("demoting %d lanes below %s (%s)",
+                                len(sub), rung, e.kind)
+            if rs is None:
+                self.telemetry.record("exhausted")
+                if on_exhausted == "raise":
+                    raise last_err or EngineFailure(
+                        "ladder", "unavailable",
+                        "no engine could take the batch")
+                rs = [wgl_host.WGLResult(valid="unknown")
+                      for _ in sub]
+            # only demotions past the FIRST eligible rung count (a
+            # CPU-only host legitimately starts at native/host); the
+            # extra eligibility scan is paid only on unclean chunks
+            extra = 0
+            if demoted_here:
+                first = self._first_eligible(ladder, model, sub)
+                extra = max(0, demoted_here - first)
+            if extra:
+                self.telemetry.record("demotions", extra)
+                any_demotion = True
+            else:
+                clean_chunks += 1
+            for i, r in zip(chunk, rs):
+                out[i] = r
+        if any_demotion and clean_chunks:
+            # chunks that completed on their first-choice rung while a
+            # sibling chunk demoted: their verdicts were salvaged
+            # rather than re-run or thrown away
+            self.telemetry.record("salvaged_chunks", clean_chunks)
+        return out
+
+    def _first_eligible(self, ladder, model, sub) -> int:
+        """Index of the first rung that is ELIGIBLE for this work
+        regardless of health — the baseline against which demotions
+        are counted (ineligible rungs above it are routing, not
+        degradation)."""
+        for i, rung in enumerate(self._rungs(ladder, model, sub)):
+            elig = self.eligibility.get(rung)
+            if elig is None or elig(model, sub):
+                return i
+        return 0
+
+    # -- first-compile probing -------------------------------------------
+
+    def probe_engine(self, engine: str, cmd: list | None = None,
+                     timeout: float | None = None) -> bool:
+        """Run the engine's first compile in a SUBPROCESS so a FATAL
+        abort (Mosaic/XLA can kill the process outright) is contained.
+        A failed probe trips the breaker; the result is cached per
+        process. `cmd` overrides the probe command (tests)."""
+        with self._probe_lock:
+            if engine in self._probed:
+                return self._probed[engine]
+        if cmd is None:
+            cmd = [sys.executable, "-c",
+                   "from jepsen_tpu.checker import supervisor; "
+                   f"supervisor._probe_main({engine!r})"]
+        ok = False
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=timeout if timeout is not None
+                else self.config.probe_timeout,
+                env={**os.environ, "JEPSEN_TPU_PROBE": engine})
+            ok = proc.returncode == 0
+            if not ok:
+                log.warning("first-compile probe for %s died rc=%s: %s",
+                            engine, proc.returncode,
+                            (proc.stderr or "")[-500:])
+        except (subprocess.TimeoutExpired, OSError) as e:
+            log.warning("first-compile probe for %s failed: %s", engine, e)
+        if not ok:
+            self.telemetry.record("probe_failures")
+            self.breaker.trip(engine)
+            self.telemetry.record("breaker_trips")
+        with self._probe_lock:
+            self._probed[engine] = ok
+        return ok
+
+
+def _probe_main(engine: str) -> None:
+    """Subprocess entry point: compile-and-run the engine's minimal
+    lane. Exit status is the probe verdict; a FATAL abort here is
+    contained by the parent."""
+    from ..ops import wgl_native, wgl_pallas_vec, wgl_tpu
+
+    probe = {"pallas": wgl_pallas_vec.probe, "tpu": wgl_tpu.probe,
+             "native": wgl_native.probe}[engine]
+    sys.exit(0 if probe() else 1)
+
+
+# ---------------------------------------------------------------------------
+# Per-process singleton
+
+_lock = threading.Lock()
+_supervisor: Supervisor | None = None
+
+
+def _env_config() -> SupervisorConfig:
+    """Operator knobs for the default supervisor: JEPSEN_TPU_SUP_PROBE=1
+    enables the subprocess first-compile probe (worth its ~seconds of
+    child startup on real TPU fleets where a FATAL Mosaic abort costs
+    the whole analysis); JEPSEN_TPU_SUP_TIMEOUT=<seconds> sets a hard
+    per-call watchdog."""
+    cfg = SupervisorConfig()
+    if os.environ.get("JEPSEN_TPU_SUP_PROBE") == "1":
+        cfg.probe_first_compile = True
+    t = os.environ.get("JEPSEN_TPU_SUP_TIMEOUT")
+    if t:
+        try:
+            cfg.call_timeout = float(t)
+        except ValueError:
+            log.warning("ignoring non-numeric JEPSEN_TPU_SUP_TIMEOUT=%r", t)
+    return cfg
+
+
+def get() -> Supervisor:
+    """The process-wide supervisor the checker routes through."""
+    global _supervisor
+    with _lock:
+        if _supervisor is None:
+            _supervisor = Supervisor(_env_config())
+        return _supervisor
+
+
+def _reset_for_tests(sup: Supervisor | None = None) -> None:
+    """Swap/clear the singleton (test hook)."""
+    global _supervisor
+    with _lock:
+        _supervisor = sup

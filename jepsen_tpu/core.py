@@ -41,9 +41,16 @@ log = logging.getLogger("jepsen_tpu.core")
 
 
 def conj_op(test, op: Op) -> Op:
-    """Append an op to the test's history (core.clj:30-38)."""
+    """Append an op to the test's history (core.clj:30-38), and to the
+    durability WAL when the run carries one (store.HistoryWAL) — so a
+    killed run leaves the ops it completed on disk."""
     with test["_history_lock"]:
         test["_history"].append(op)
+        # journal INSIDE the critical section: WAL line order must match
+        # history order, or the reindexing fallback loader permutes ops
+        wal = test.get("_wal")
+        if wal is not None:
+            wal.append(op)
     return op
 
 
@@ -414,14 +421,29 @@ class NemesisWorker(Worker):
                 op = op.with_(type="info")
             self._apply(op)
 
+    @staticmethod
+    def _journal(test, wal, op: Op) -> None:
+        """Append to every active history; the WAL line lands under the
+        MAIN history's lock (nemesis ops bypass conj_op) so WAL order
+        matches history order for the reindexing fallback loader."""
+        main_lock = test.get("_history_lock")
+        journaled = False
+        for hist, lock in list(test["active_histories"]):
+            with lock:
+                hist.append(op)
+                if wal is not None and lock is main_lock:
+                    wal.append(op)
+                    journaled = True
+        if wal is not None and not journaled:
+            wal.append(op)
+
     def _apply(self, op: Op) -> Op:
         """Journal to ALL active histories, invoke, journal completion
         (core.clj:338-350); exceptions -> :info (core.clj:308-336)."""
         test = self.test
         log_op_logger(op)
-        for hist, lock in list(test["active_histories"]):
-            with lock:
-                hist.append(op)
+        wal = test.get("_wal")
+        self._journal(test, wal, op)
         try:
             completion = self.nemesis.invoke(test, op).with_(
                 time=relative_time_nanos()
@@ -437,9 +459,7 @@ class NemesisWorker(Worker):
                 time=relative_time_nanos(),
                 error=f"indeterminate: {e}",
             )
-        for hist, lock in list(test["active_histories"]):
-            with lock:
-                hist.append(completion)
+        self._journal(test, wal, completion)
         log_op_logger(completion)
         return completion
 
@@ -456,6 +476,18 @@ def run_case(test) -> list:
     test["_history"] = history
     test["_history_lock"] = lock
     test["active_histories"].append((history, lock))
+    wal = None
+    if test.get("name") and test.get("start_time"):
+        # durability sidecar: every op lands on disk as it happens, so
+        # a SIGKILL'd run leaves a partial history load_history can read
+        try:
+            from . import store
+
+            wal = store.HistoryWAL(test)
+            test["_wal"] = wal
+        except Exception:  # noqa: BLE001 — best-effort durability
+            log.warning("couldn't open history WAL", exc_info=True)
+            wal = None
     try:
         nodes = test["nodes"] or [None]
         client_nodes = [
@@ -468,6 +500,9 @@ def run_case(test) -> list:
         run_workers(test, workers)
     finally:
         test["active_histories"].remove((history, lock))
+        if wal is not None:
+            test.pop("_wal", None)
+            wal.close()
     return history
 
 

@@ -187,6 +187,31 @@ def subhistory(k, history) -> list:
     return out
 
 
+def _merge_supervision(results) -> dict:
+    """Aggregate per-key "supervision" telemetry deltas into one
+    top-level dict. check_batch attaches ONE shared dict object to
+    every item of a batch (the pass was one supervised run), so dedup
+    by object identity before summing; the per-key fallback path
+    attaches genuinely distinct deltas, which sum normally."""
+    seen: list = []
+    for r in results:
+        d = r.get("supervision") if isinstance(r, dict) else None
+        if d is not None and not any(d is s for s in seen):
+            seen.append(d)
+    out: dict = {}
+    for d in seen:
+        for k, v in d.items():
+            if isinstance(v, dict):  # per_engine: {engine: {kind: n}}
+                tgt = out.setdefault(k, {})
+                for eng, kinds in v.items():
+                    et = tgt.setdefault(eng, {})
+                    for kind, n in kinds.items():
+                        et[kind] = et.get(kind, 0) + n
+            else:
+                out[k] = out.get(k, 0) + v
+    return out
+
+
 class IndependentChecker(Checker):
     """Lift a checker over v to one over [k v] tuples: check each key's
     subhistory (in parallel), merge validities, list failing keys
@@ -286,11 +311,15 @@ class IndependentChecker(Checker):
         # excluded, as in the reference (independent.clj:283-291, where
         # :unknown is truthy)
         failures = [k for k, r in results.items() if r["valid"] is False]
-        return {
+        out = {
             "valid": merge_valid(r["valid"] for r in results.values()),
             "results": results,
             "failures": failures,
         }
+        sup = _merge_supervision(results.values())
+        if sup:
+            out["supervision"] = sup
+        return out
 
     @staticmethod
     def _write_artifacts(test, subdir, sub, result) -> None:

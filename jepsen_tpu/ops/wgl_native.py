@@ -165,5 +165,53 @@ def analysis(
                      steps=int(steps))
 
 
+def analysis_batch(
+    model: Model,
+    entries_list,
+    max_steps: int | None = None,
+    time_limit: float | None = None,
+    max_workers: int = 16,
+) -> list[WGLResult]:
+    """Check many independent histories with the native engine, fanned
+    over a thread pool (ctypes drops the GIL for the search's duration,
+    so lanes genuinely run in parallel on multi-core control nodes —
+    the reference's bounded-pmap per-key checking,
+    independent.clj:269-287). Raises NativeUnavailable when the library
+    won't build or ANY lane has no native encoding: the supervised
+    ladder (checker/supervisor.py) treats that as "demote the chunk",
+    keeping this engine's contract all-or-nothing per call."""
+    ess = [es if isinstance(es, Entries) else make_entries(es)
+           for es in entries_list]
+    _get_lib()  # raises NativeUnavailable without a toolchain
+    for i, es in enumerate(ess):
+        if not eligible(model, es):
+            raise NativeUnavailable(
+                f"lane {i} has no native encoding for {model!r}")
+
+    def one(es):
+        return analysis(model, es, time_limit=time_limit,
+                        max_steps=max_steps)
+
+    workers = min(len(ess), os.cpu_count() or 1, max_workers)
+    if workers > 1 and len(ess) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return list(pool.map(one, ess))
+    return [one(es) for es in ess]
+
+
+def probe() -> bool:
+    """Compile the library and run one trivial lane end-to-end. The
+    supervisor's first-compile probe runs this in a subprocess so a
+    toolchain crash is contained (checker/supervisor.py)."""
+    from ..history import Op
+    from ..models import CASRegister
+
+    h = [Op(0, "invoke", "write", 1, time=0, index=0),
+         Op(0, "ok", "write", 1, time=1, index=1)]
+    return analysis(CASRegister(None), h, max_steps=10_000).valid is True
+
+
 def check(model: Model, history, **kw) -> dict:
     return analysis(model, history, **kw).to_dict()

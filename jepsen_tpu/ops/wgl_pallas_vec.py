@@ -1197,3 +1197,18 @@ def analysis_batch(model, entries_list, max_steps: int | None = None,
         for i in survivors:
             results[i] = result(entries_list[i], small1, best1, i)
     return results
+
+
+def probe() -> bool:
+    """Compile-and-run one minimal lane through the full batch path
+    (encode, pack, Mosaic compile, launch, fetch). The supervisor's
+    first-compile probe (checker/supervisor.py) runs this in a
+    SUBPROCESS: a FATAL Mosaic/XLA abort here kills the probe child,
+    not the analysis — the parent merely quarantines the engine."""
+    from ..history import Op
+    from ..models import CASRegister
+
+    h = [Op(0, "invoke", "write", 1, time=0, index=0),
+         Op(0, "ok", "write", 1, time=1, index=1)]
+    (r,) = analysis_batch(CASRegister(None), [h], max_steps=10_000)
+    return r.valid is True

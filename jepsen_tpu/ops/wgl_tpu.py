@@ -767,3 +767,18 @@ def analysis(
         model, [es], cache_bits=cache_bits, max_steps=max_steps
     )
     return r
+
+
+def probe() -> bool:
+    """Compile-and-run one minimal lane through the vmapped kernel
+    (trace, XLA compile, launch, fetch). Run in a subprocess by the
+    supervisor's first-compile probe (checker/supervisor.py) so a
+    FATAL compile abort is contained."""
+    from ..history import Op, entries as make_entries
+    from ..models import CASRegister
+
+    h = [Op(0, "invoke", "write", 1, time=0, index=0),
+         Op(0, "ok", "write", 1, time=1, index=1)]
+    (r,) = analysis_batch(CASRegister(None), [make_entries(h)],
+                          max_steps=10_000)
+    return r.valid is True

@@ -10,7 +10,9 @@ connection that failed) before re-raising."""
 from __future__ import annotations
 
 import logging
+import random
 import threading
+import time
 from contextlib import contextmanager
 from typing import Any, Callable
 
@@ -129,14 +131,63 @@ class Wrapper:
         close: Callable[[Any], None],
         name: str | None = None,
         log_reconnects: bool = True,
+        max_retries: int = 1,
+        backoff_base: float = 0.05,
+        backoff_cap: float = 5.0,
+        seed: int | None = None,
     ):
+        """max_retries is the number of open ATTEMPTS per (re)open
+        (default 1 — the historical immediate-single-attempt behavior);
+        between failed attempts we sleep a capped exponential backoff
+        with seeded jitter, and the LAST error surfaces to the caller."""
         assert callable(open) and callable(close)
+        assert max_retries >= 1
         self._open = open
         self._close = close
         self.name = name
         self.log_reconnects = log_reconnects
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
         self.lock = RWLock()
         self._conn: Any = None
+
+    def _backoff(self, attempt: int) -> float:
+        """Capped exponential backoff with jitter in [0.5x, 1.5x) —
+        seeded so a test run's reconnect schedule replays exactly."""
+        with self._rng_lock:
+            jitter = 0.5 + self._rng.random()
+        return min(self.backoff_cap, self.backoff_base * 2 ** attempt) * jitter
+
+    def _open_retry(self):
+        """One logical open = up to max_retries attempts with backoff;
+        raises the last error when all fail. Called under the write
+        lock."""
+        last: Exception | None = None
+        for attempt in range(self.max_retries):
+            if attempt:
+                delay = self._backoff(attempt - 1)
+                if self.log_reconnects:
+                    log.warning(
+                        "Reopen %r attempt %d/%d failed; retrying in "
+                        "%.2fs", self.name, attempt, self.max_retries,
+                        delay)
+                time.sleep(delay)
+            try:
+                c = self._open()
+            except Exception as e:  # noqa: BLE001
+                last = e
+                continue
+            if c is None:
+                raise RuntimeError(
+                    f"Reconnect wrapper {self.name!r}'s open function "
+                    "returned None instead of a connection!"
+                )
+            return c
+        assert last is not None
+        raise last
 
     def conn(self):
         """The active connection, if any (reconnect.clj:49-52)."""
@@ -147,13 +198,7 @@ class Wrapper:
         (reconnect.clj:54-66)."""
         with self.lock.write():
             if self._conn is None:
-                c = self._open()
-                if c is None:
-                    raise RuntimeError(
-                        f"Reconnect wrapper {self.name!r}'s open function "
-                        "returned None instead of a connection!"
-                    )
-                self._conn = c
+                self._conn = self._open_retry()
         return self
 
     def close(self) -> "Wrapper":
@@ -171,13 +216,7 @@ class Wrapper:
             if self._conn is not None:
                 self._close(self._conn)
                 self._conn = None
-            c = self._open()
-            if c is None:
-                raise RuntimeError(
-                    f"Reconnect wrapper {self.name!r}'s open function "
-                    "returned None instead of a connection!"
-                )
-            self._conn = c
+            self._conn = self._open_retry()
         return self
 
     @contextmanager
@@ -207,14 +246,7 @@ class Wrapper:
                                 self._close(self._conn)
                             finally:
                                 self._conn = None
-                        c2 = self._open()
-                        if c2 is None:
-                            raise RuntimeError(
-                                f"Reconnect wrapper {self.name!r}'s open "
-                                "function returned None instead of a "
-                                "connection!"
-                            )
-                        self._conn = c2
+                        self._conn = self._open_retry()
             except Exception:  # noqa: BLE001
                 # Log but don't mask the original transaction error
                 if self.log_reconnects:
@@ -226,5 +258,6 @@ class Wrapper:
             self.lock.release_read()
 
 
-def wrapper(open, close, name=None, log_reconnects=True) -> Wrapper:
-    return Wrapper(open, close, name=name, log_reconnects=log_reconnects)
+def wrapper(open, close, name=None, log_reconnects=True, **kw) -> Wrapper:
+    return Wrapper(open, close, name=name, log_reconnects=log_reconnects,
+                   **kw)

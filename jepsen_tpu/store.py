@@ -31,6 +31,7 @@ import json
 import logging
 import os
 import shutil
+import threading
 from typing import Any, Iterable
 
 from .history import Op, TensorHistory
@@ -164,6 +165,55 @@ def write_json(test, subpath, value) -> str:
 write_edn = write_json
 
 
+#: incremental-durability sidecar: one JSON op per line, appended as ops
+#: land during the run (vs history.jsonl, written once at save_1)
+WAL_FILE = "history.wal.jsonl"
+
+
+class HistoryWAL:
+    """Append-only JSONL write-ahead log of the live history.
+
+    ``run_case`` opens one per run and ``core.conj_op`` appends every op
+    (invocations AND completions) the moment it lands, each line flushed
+    so a SIGKILL'd run leaves an analyzable partial history on disk for
+    ``load_history`` to fall back to — the in-memory history plus a
+    final ``store.write_history`` is otherwise all-or-nothing. A torn
+    final line (killed mid-write) is expected and tolerated on load.
+
+    Appends are serialized by a lock: client workers and the nemesis
+    land ops concurrently. A failed append disables the WAL rather than
+    failing the run — durability is best-effort, the verdict is not."""
+
+    def __init__(self, test):
+        self._path = path_(test, WAL_FILE)
+        self._lock = threading.Lock()
+        self._f = open(self._path, "a")
+
+    def append(self, op: Op) -> None:
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.write(json.dumps(op.to_dict(),
+                                         default=_json_default))
+                self._f.write("\n")
+                self._f.flush()
+            except Exception:  # noqa: BLE001 — best-effort durability
+                log.warning("history WAL append failed; disabling",
+                            exc_info=True)
+                try:
+                    self._f.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._f = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
 def write_history_txt(test, subpath, history: Iterable[Op]) -> str:
     """history.txt: one tab-separated line per op (util/pwrite-history!
     format, util.clj:184-206)."""
@@ -288,7 +338,10 @@ def tests(name=None, store_dir=None) -> dict:
 
 
 def load_history(test) -> list[Op]:
-    """Reload a run's history, preferring the jsonl form."""
+    """Reload a run's history, preferring the jsonl form. A run that
+    died before save_1 (SIGKILL, OOM, power) leaves no history.jsonl —
+    fall back to the WAL the run appended as ops landed, tolerating a
+    torn final line."""
     p = path(test, "history.jsonl")
     if os.path.exists(p):
         with open(p) as f:
@@ -296,6 +349,23 @@ def load_history(test) -> list[Op]:
     p = path(test, "history.npz")
     if os.path.exists(p):
         return TensorHistory.load(p).decode()
+    p = path(test, WAL_FILE)
+    if os.path.exists(p):
+        out = []
+        with open(p) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    out.append(Op.from_dict(json.loads(line)))
+                except (ValueError, KeyError):
+                    # torn tail from a mid-write kill: salvage the prefix
+                    log.warning("WAL: dropping unparseable line %r",
+                                line[:80])
+        # WAL lines land BEFORE history finalization assigns indices
+        # (index=-1); reindex in arrival order so the salvaged history
+        # is analyzable (pairs/checkers require monotonic indices)
+        return [o.with_(index=i) for i, o in enumerate(out)]
     raise FileNotFoundError(f"no stored history under {path(test)}")
 
 

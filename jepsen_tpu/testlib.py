@@ -109,6 +109,48 @@ class FlakyClient(AtomClient):
         return completion
 
 
+class FlakyEngine:
+    """Deterministic fault injection for checker-engine batch calls —
+    the chaos fixture the supervisor tests (tests/test_supervisor.py)
+    drive the degradation ladder with.
+
+    Wraps an engine's batch function with a seeded SCHEDULE of faults,
+    one entry per call: None passes through to the wrapped engine,
+    "fail" raises a transient error, "oom" raises a device-OOM-shaped
+    error (the supervisor's bisection trigger), "hang" sleeps hang_s
+    then proceeds (trips the watchdog when hang_s exceeds the call
+    timeout). Past the schedule's end every call passes through. The
+    instance records (kind, n_lanes) per call in .log and counts calls
+    in .calls — a quarantined engine is asserted by .calls holding
+    still."""
+
+    def __init__(self, fn, schedule=(), hang_s: float = 1.0):
+        self.fn = fn
+        self.schedule = list(schedule)
+        self.hang_s = hang_s
+        self.calls = 0
+        self.log: list = []
+        self._lock = threading.Lock()
+
+    def __call__(self, model, ess, max_steps=None, time_limit=None):
+        import time as _t
+
+        with self._lock:
+            i = self.calls
+            self.calls += 1
+            kind = self.schedule[i] if i < len(self.schedule) else None
+            self.log.append((kind, len(ess)))
+        if kind == "fail":
+            raise RuntimeError("injected transient engine failure")
+        if kind == "oom":
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected out of memory")
+        if kind == "hang":
+            _t.sleep(self.hang_s)
+        return self.fn(model, ess, max_steps=max_steps,
+                       time_limit=time_limit)
+
+
 def cas_test(state: SharedAtom | None = None, **overrides) -> dict:
     """The reference's basic-cas-test shape (core_test.clj:18-30): full
     engine against the atom backend, linearizable checker."""

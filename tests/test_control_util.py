@@ -333,6 +333,99 @@ class TestReconnect:
         assert len(opened) == 2
 
 
+@pytest.mark.chaos
+class TestReconnectBackoff:
+    """Retry-with-backoff on (re)open: capped exponential delays with
+    seeded jitter between attempts, the LAST error surfacing when every
+    attempt fails, and single-attempt behavior preserved by default."""
+
+    def _flaky_wrapper(self, failures, sleeps, **kw):
+        """open() raises `failures` times, then succeeds; sleeps are
+        captured instead of slept."""
+        calls = {"n": 0}
+
+        def op():
+            calls["n"] += 1
+            if calls["n"] <= failures:
+                raise RuntimeError(f"open attempt {calls['n']} failed")
+            return object()
+
+        w = reconnect.wrapper(op, lambda c: None, name="w",
+                              log_reconnects=False, seed=7, **kw)
+        orig = reconnect.time.sleep
+        reconnect.time.sleep = sleeps.append
+        self._restore = lambda: setattr(reconnect.time, "sleep", orig)
+        return w, calls
+
+    def teardown_method(self):
+        restore = getattr(self, "_restore", None)
+        if restore:
+            restore()
+
+    def test_retries_until_success(self):
+        sleeps = []
+        w, calls = self._flaky_wrapper(2, sleeps, max_retries=3,
+                                       backoff_base=0.05, backoff_cap=5.0)
+        w.open()
+        assert w.conn() is not None
+        assert calls["n"] == 3
+        assert len(sleeps) == 2  # a sleep between attempts, not before
+        # exponential: second delay drawn from double the first's base
+        assert 0.025 <= sleeps[0] <= 0.075  # 0.05 * [0.5, 1.5)
+        assert 0.05 <= sleeps[1] <= 0.15    # 0.10 * [0.5, 1.5)
+
+    def test_backoff_is_capped(self):
+        sleeps = []
+        w, _ = self._flaky_wrapper(4, sleeps, max_retries=5,
+                                   backoff_base=1.0, backoff_cap=1.5)
+        w.open()
+        assert all(s <= 1.5 * 1.5 for s in sleeps)  # cap * max jitter
+
+    def test_last_error_surfaces_when_exhausted(self):
+        sleeps = []
+        w, calls = self._flaky_wrapper(99, sleeps, max_retries=3)
+        with pytest.raises(RuntimeError, match="attempt 3 failed"):
+            w.open()
+        assert calls["n"] == 3
+        assert w.conn() is None
+
+    def test_default_is_single_attempt(self):
+        sleeps = []
+        w, calls = self._flaky_wrapper(1, sleeps)
+        with pytest.raises(RuntimeError, match="attempt 1"):
+            w.open()
+        assert calls["n"] == 1 and sleeps == []
+
+    def test_seeded_jitter_replays(self):
+        a, b = [], []
+        wa, _ = self._flaky_wrapper(2, a, max_retries=3)
+        wa.open()
+        self._restore()
+        wb, _ = self._flaky_wrapper(2, b, max_retries=3)
+        wb.open()
+        assert a == b  # same seed -> identical backoff schedule
+
+    def test_reopen_retries_too(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def op():
+            calls["n"] += 1
+            if calls["n"] == 2:  # first REOPEN attempt fails
+                raise RuntimeError("transient")
+            return object()
+
+        w = reconnect.wrapper(op, lambda c: None, log_reconnects=False,
+                              max_retries=2, seed=0)
+        orig = reconnect.time.sleep
+        reconnect.time.sleep = sleeps.append
+        self._restore = lambda: setattr(reconnect.time, "sleep", orig)
+        w.open()
+        w.reopen()
+        assert calls["n"] == 3 and len(sleeps) == 1
+        assert w.conn() is not None
+
+
 class TestOsDist:
     def test_debian_setup_dummy(self):
         remote = DummyRemote()

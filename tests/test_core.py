@@ -221,6 +221,36 @@ class TestOpTimeouts:
             o.error == "op timed out" for o in test["history"]
         )
 
+    @pytest.mark.chaos
+    def test_abandoned_invoker_keeps_worker_running(self):
+        """The op_timeout abandoned-invoker path in
+        ClientWorker._invoke: a client hung past the deadline yields an
+        :info completion, the WORKER keeps running (it takes the next
+        op instead of dying with the stuck invoke), and
+        history.crashed_invokes reports the abandoned op."""
+        from jepsen_tpu import history as hist_mod
+
+        test = cas_test()
+        test["client"] = self.HangingClient()
+        test["op_timeout"] = 0.1
+        test["concurrency"] = 1  # ONE worker must survive all 3 hangs
+        test["generator"] = gen.clients(
+            gen.limit(3, {"f": "write", "value": 1})
+        )
+        test = core.run(test)
+        hist = test["history"]
+        infos = [o for o in hist
+                 if o.is_info and isinstance(o.process, int)]
+        # the worker kept running: all 3 ops were attempted and each
+        # hung invoke completed :info rather than killing the thread
+        assert len(infos) == 3
+        assert all(o.error == "op timed out" for o in infos)
+        crashed = hist_mod.crashed_invokes(hist)
+        assert len(crashed) == 3
+        assert all(o.is_invoke and o.f == "write" for o in crashed)
+        # indeterminate, not failed: :info ops stay possibly-applied
+        assert not any(o.is_fail for o in hist)
+
 
 class TestOpDeadlineAnnotation:
     def test_time_limit_annotates_ops(self):

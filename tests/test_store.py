@@ -215,3 +215,82 @@ class TestFullRunRoundTrip:
         test = core.run(cas_test(state))
         logtxt = open(store.path(test, "jepsen.log")).read()
         assert "Analyzing" in logtxt
+
+
+@pytest.mark.chaos
+class TestHistoryWAL:
+    """Incremental durability: ops land on disk as they happen, so a
+    SIGKILL'd run leaves an analyzable partial history."""
+
+    def test_wal_appends_and_loads_back(self):
+        test = t0()
+        wal = store.HistoryWAL(test)
+        for o in HIST:
+            wal.append(o)
+        wal.close()
+        # no history.jsonl / history.npz: load falls back to the WAL
+        loaded = store.load_history(test)
+        assert [o.to_dict() for o in loaded] == [o.to_dict() for o in HIST]
+
+    def test_wal_survives_without_close(self):
+        """Per-append flush: the file is complete even if close() never
+        runs (the SIGKILL shape)."""
+        test = t0()
+        wal = store.HistoryWAL(test)
+        for o in HIST:
+            wal.append(o)
+        loaded = store.load_history(test)  # wal still open
+        assert len(loaded) == len(HIST)
+        wal.close()
+
+    def test_torn_final_line_is_tolerated(self):
+        test = t0()
+        wal = store.HistoryWAL(test)
+        for o in HIST:
+            wal.append(o)
+        wal.close()
+        with open(store.path(test, store.WAL_FILE), "a") as f:
+            f.write('{"process": 2, "type": "inv')  # killed mid-write
+        loaded = store.load_history(test)
+        assert len(loaded) == len(HIST)  # prefix salvaged, tail dropped
+
+    def test_history_jsonl_still_preferred(self):
+        test = t0(history=list(HIST))
+        wal = store.HistoryWAL(test)
+        wal.append(HIST[0])  # WAL shorter than the real history
+        wal.close()
+        store.save_1(test)
+        assert len(store.load_history(test)) == len(HIST)
+
+    def test_append_after_close_is_a_noop(self):
+        test = t0()
+        wal = store.HistoryWAL(test)
+        wal.close()
+        wal.append(HIST[0])  # must not raise
+        loaded = store.load_history(test)
+        assert loaded == []
+
+    def test_run_case_writes_wal(self):
+        """The engine opens the WAL for real runs: every op of the
+        final history is also on disk in the WAL, in landing order."""
+        test = core.run(cas_test(SharedAtom()))
+        p = store.path(test, store.WAL_FILE)
+        assert os.path.exists(p)
+        with open(p) as f:
+            wal_ops = [json.loads(line) for line in f if line.strip()]
+        assert len(wal_ops) == len(test["history"])
+        assert "_wal" not in test  # closed and detached after the run
+
+    def test_wal_fallback_reindexes_live_ops(self):
+        """conj_op journals ops BEFORE finalization assigns indices
+        (index=-1 on disk); the fallback loader must reindex in arrival
+        order or the salvaged history can't be paired or checked."""
+        test = core.run(cas_test(SharedAtom()))
+        for name in ("history.jsonl", "history.npz"):
+            p = store.path(test, name)
+            if os.path.exists(p):
+                os.remove(p)
+        recovered = store.load_history(test)
+        assert [o.index for o in recovered] == list(range(len(recovered)))
+        assert [(o.process, o.type, o.f) for o in recovered] == \
+            [(o.process, o.type, o.f) for o in test["history"]]

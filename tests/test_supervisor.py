@@ -1,0 +1,363 @@
+"""Supervised engine dispatch (checker/supervisor.py): deadlines,
+retry/backoff, OOM bisection, the circuit breaker, the degradation
+ladder, chunk salvage, and the subprocess first-compile probe — all
+driven by the deterministic FlakyEngine fixture (testlib.py), sim-backed
+and fast (tiny histories, millisecond backoffs)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+import pytest
+
+from jepsen_tpu.checker import supervisor as sup_mod
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.history import Op, entries as make_entries
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.testlib import FlakyEngine
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singleton():
+    """Never leak a test supervisor (tripped breakers, tiny chunking)
+    into other tests' checker runs."""
+    yield
+    sup_mod._reset_for_tests(None)
+
+
+def _history(valid: bool = True) -> list[Op]:
+    v = 1 if valid else 2  # read 2 after write 1 -> not linearizable
+    return [
+        Op(0, "invoke", "write", 1, time=0, index=0),
+        Op(0, "ok", "write", 1, time=1, index=1),
+        Op(1, "invoke", "read", None, time=2, index=2),
+        Op(1, "ok", "read", v, time=3, index=3),
+    ]
+
+
+MODEL = CASRegister(None)
+
+
+def host_batch(model, ess, max_steps=None, time_limit=None):
+    """The reference backend under test: the pure-Python engine with
+    the supervisor's uniform batch signature."""
+    return sup_mod._run_host(model, ess, max_steps=max_steps,
+                             time_limit=time_limit)
+
+
+def config(**kw) -> sup_mod.SupervisorConfig:
+    """Test defaults: millisecond backoffs, lane-level chunks."""
+    base = dict(backoff_base=0.001, backoff_cap=0.002, chunk_lanes=2,
+                breaker_threshold=3, breaker_cooldown=30.0, bisect_min=1)
+    base.update(kw)
+    return sup_mod.SupervisorConfig(**base)
+
+
+def supervisor(registry, **kw) -> sup_mod.Supervisor:
+    return sup_mod.Supervisor(config(**kw), registry=registry,
+                              eligibility={})
+
+
+class TestClassifyError:
+    def test_oom_markers(self):
+        assert sup_mod.classify_error(
+            RuntimeError("RESOURCE_EXHAUSTED: while allocating")) == "oom"
+        assert sup_mod.classify_error(MemoryError()) == "oom"
+
+    def test_timeout(self):
+        assert sup_mod.classify_error(
+            sup_mod.EngineTimeout("x")) == "timeout"
+
+    def test_unavailable(self):
+        from jepsen_tpu.ops.wgl_native import NativeUnavailable
+
+        assert sup_mod.classify_error(
+            NativeUnavailable("no compiler")) == "unavailable"
+        assert sup_mod.classify_error(
+            ValueError("lane 3: no int32 encoding")) == "unavailable"
+        assert sup_mod.classify_error(
+            ImportError("jax")) == "unavailable"
+
+    def test_default_transient(self):
+        assert sup_mod.classify_error(
+            RuntimeError("socket closed")) == "transient"
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_half_opens(self):
+        t = [0.0]
+        br = sup_mod.CircuitBreaker(3, 10.0, clock=lambda: t[0])
+        assert br.healthy("e")
+        assert br.record_failure("e") is False
+        assert br.record_failure("e") is False
+        assert br.record_failure("e") is True  # trips
+        assert not br.healthy("e")
+        t[0] = 10.5  # cooldown elapsed: half-open allows one attempt
+        assert br.healthy("e")
+        assert br.record_failure("e") is True  # re-trips immediately
+        assert not br.healthy("e")
+
+    def test_success_resets(self):
+        br = sup_mod.CircuitBreaker(2, 10.0)
+        br.record_failure("e")
+        br.record_success("e")
+        assert br.record_failure("e") is False  # streak restarted
+
+
+class TestCall:
+    def test_retry_then_succeed(self):
+        flaky = FlakyEngine(host_batch, schedule=["fail", None])
+        sup = supervisor({"pallas": flaky}, max_retries=2)
+        ess = [make_entries(_history())]
+        (r,) = sup.call("pallas", MODEL, ess)
+        assert r.valid is True
+        snap = sup.telemetry.snapshot()
+        assert snap["retries"] == 1
+        assert snap["per_engine"]["pallas"]["transient"] == 1
+        assert flaky.calls == 2
+
+    def test_unavailable_demotes_without_retry(self):
+        def ineligible(model, ess, max_steps=None, time_limit=None):
+            raise ValueError("lane 0 ineligible for this engine")
+
+        sup = supervisor({"pallas": ineligible}, max_retries=2)
+        with pytest.raises(sup_mod.EngineFailure) as ei:
+            sup.call("pallas", MODEL, [make_entries(_history())])
+        assert ei.value.kind == "unavailable"
+        snap = sup.telemetry.snapshot()
+        assert snap["retries"] == 0  # demote, don't burn retries
+        assert snap["engine_failures"] == 0  # not a health event
+        assert sup.healthy("pallas")
+
+    def test_exhaustion_raises_engine_failure(self):
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 5)
+        sup = supervisor({"pallas": flaky}, max_retries=1,
+                         breaker_threshold=99)
+        with pytest.raises(sup_mod.EngineFailure) as ei:
+            sup.call("pallas", MODEL, [make_entries(_history())])
+        assert ei.value.kind == "transient"
+        assert flaky.calls == 2  # initial + 1 retry
+
+    def test_watchdog_timeout(self):
+        flaky = FlakyEngine(host_batch, schedule=["hang"], hang_s=1.0)
+        sup = supervisor({"pallas": flaky}, max_retries=0,
+                         call_timeout=0.15)
+        with pytest.raises(sup_mod.EngineFailure) as ei:
+            sup.call("pallas", MODEL, [make_entries(_history())])
+        assert ei.value.kind == "timeout"
+        assert sup.telemetry.snapshot()["timeouts"] == 1
+        # the worker thread was abandoned, not killed
+        assert any(t.is_alive() for t in sup_mod._abandoned)
+
+    def test_result_count_mismatch_is_a_failure(self):
+        def short(model, ess, max_steps=None, time_limit=None):
+            return host_batch(model, ess[:-1])
+
+        sup = supervisor({"pallas": short}, max_retries=0,
+                         breaker_threshold=99)
+        with pytest.raises(sup_mod.EngineFailure):
+            sup.call("pallas", MODEL,
+                     [make_entries(_history()) for _ in range(2)])
+
+
+class TestBisection:
+    def test_oom_splits_chunk_and_salvages_verdicts(self):
+        flaky = FlakyEngine(host_batch, schedule=["oom"])
+        sup = supervisor({"pallas": flaky}, max_retries=0,
+                         breaker_threshold=99, bisect_min=1)
+        ess = [make_entries(_history(valid=(i % 2 == 0)))
+               for i in range(4)]
+        rs = sup.call("pallas", MODEL, ess)
+        assert [r.valid for r in rs] == [True, False, True, False]
+        snap = sup.telemetry.snapshot()
+        assert snap["bisections"] == 1
+        assert flaky.calls == 3  # whole batch OOMs, two halves succeed
+        assert flaky.log[0] == ("oom", 4)
+        assert [n for _, n in flaky.log[1:]] == [2, 2]
+
+    def test_no_bisection_below_floor(self):
+        flaky = FlakyEngine(host_batch, schedule=["oom"] * 3)
+        sup = supervisor({"pallas": flaky}, max_retries=2,
+                         breaker_threshold=99, bisect_min=64)
+        with pytest.raises(sup_mod.EngineFailure) as ei:
+            sup.call("pallas", MODEL, [make_entries(_history())])
+        assert ei.value.kind == "oom"
+        assert sup.telemetry.snapshot()["bisections"] == 0
+
+
+class TestLadder:
+    def test_mid_batch_failure_matches_healthy_run(self):
+        """The acceptance scenario: FlakyEngine fails the pallas rung
+        mid-batch; check_batch must return verdicts IDENTICAL to a
+        healthy run — the failing chunk demotes, the clean chunks'
+        verdicts are salvaged, nothing aborts — and the telemetry must
+        show the demotion."""
+        test = {"model": MODEL}
+        items = [(_history(valid=(i % 2 == 0)), None) for i in range(4)]
+        checker = Linearizable(algorithm="pallas")
+
+        sup_mod._reset_for_tests(supervisor(
+            {"pallas": host_batch, "host": host_batch}))
+        healthy = [r["valid"] for r in checker.check_batch(test, items)]
+        assert healthy == [True, False, True, False]
+
+        # chunk_lanes=2 -> chunks [0,1] and [2,3]; the SECOND pallas
+        # call (chunk 2) fails once with max_retries=0 -> demote to host
+        flaky = FlakyEngine(host_batch, schedule=[None, "fail"])
+        sup_mod._reset_for_tests(supervisor(
+            {"pallas": flaky, "host": host_batch}, max_retries=0))
+        results = checker.check_batch(test, items)
+        assert [r["valid"] for r in results] == healthy
+        sup = results[0]["supervision"]
+        assert sup["demotions"] >= 1
+        assert sup["salvaged_chunks"] >= 1
+        assert sup["per_engine"]["pallas"]["transient"] == 1
+        # ONE shared telemetry dict across the batch (identity matters:
+        # independent.py dedups by object identity when aggregating)
+        assert all(r["supervision"] is sup for r in results)
+
+    def test_quarantined_engine_not_attempted(self):
+        """After K consecutive failures the breaker opens and routing
+        demotes WITHOUT attempting the engine: its call count holds
+        still while verdicts keep coming from the floor."""
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 99)
+        sup = supervisor({"pallas": flaky, "host": host_batch},
+                         max_retries=0, breaker_threshold=2,
+                         chunk_lanes=8)
+        ess = [make_entries(_history())]
+        for _ in range(2):  # two failures -> breaker opens
+            (r,) = sup.run(MODEL, ess, ladder=("pallas", "host"))
+            assert r.valid is True  # demoted verdict is still THE verdict
+        assert sup.telemetry.snapshot()["breaker_trips"] == 1
+        assert not sup.healthy("pallas")
+        calls_before = flaky.calls
+        (r,) = sup.run(MODEL, ess, ladder=("pallas", "host"))
+        assert r.valid is True
+        assert flaky.calls == calls_before  # quarantined: not attempted
+
+    def test_exhausted_ladder_yields_unknown(self):
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 99)
+        sup = supervisor({"pallas": flaky}, max_retries=0,
+                         breaker_threshold=99)
+        (r,) = sup.run(MODEL, [make_entries(_history())],
+                       ladder=("pallas",), on_exhausted="unknown")
+        assert r.valid == "unknown"
+        assert sup.telemetry.snapshot()["exhausted"] == 1
+
+    def test_exhausted_ladder_raises_when_asked(self):
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 99)
+        sup = supervisor({"pallas": flaky}, max_retries=0,
+                         breaker_threshold=99)
+        with pytest.raises(sup_mod.EngineFailure):
+            sup.run(MODEL, [make_entries(_history())],
+                    ladder=("pallas",), on_exhausted="raise")
+
+    def test_check_safe_degrades_exhaustion_to_unknown(self):
+        from jepsen_tpu.checker import check_safe
+
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 99)
+        sup_mod._reset_for_tests(supervisor(
+            {"host": flaky}, max_retries=0, breaker_threshold=99))
+        checker = Linearizable(algorithm="host")
+        d = check_safe(checker, {"model": MODEL}, _history())
+        assert d["valid"] == "unknown"
+
+
+class TestSingleHistorySupervision:
+    def test_explicit_algorithm_rides_the_ladder(self):
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 99)
+        sup_mod._reset_for_tests(supervisor(
+            {"pallas": flaky, "host": host_batch}, max_retries=0))
+        d = Linearizable(algorithm="pallas").check(
+            {"model": MODEL}, _history())
+        assert d["valid"] is True
+        assert d["supervision"]["demotions"] == 1
+
+    def test_clean_check_attaches_no_supervision(self):
+        sup_mod._reset_for_tests(supervisor({"host": host_batch}))
+        d = Linearizable(algorithm="host").check(
+            {"model": MODEL}, _history())
+        assert d["valid"] is True
+        assert "supervision" not in d
+
+
+class TestProbe:
+    def test_failing_probe_trips_breaker(self):
+        sup = supervisor({"pallas": host_batch})
+        ok = sup.probe_engine(
+            "pallas", cmd=[sys.executable, "-c", "raise SystemExit(1)"],
+            timeout=30.0)
+        assert ok is False
+        assert not sup.healthy("pallas")
+        snap = sup.telemetry.snapshot()
+        assert snap["probe_failures"] == 1
+        assert snap["breaker_trips"] == 1
+        # cached: no second subprocess, same verdict
+        assert sup.probe_engine("pallas", cmd=["/nonexistent"]) is False
+
+    def test_passing_probe_is_cached(self):
+        sup = supervisor({"pallas": host_batch})
+        cmd = [sys.executable, "-c", "raise SystemExit(0)"]
+        assert sup.probe_engine("pallas", cmd=cmd, timeout=30.0) is True
+        assert sup.healthy("pallas")
+        assert sup.probe_engine("pallas") is True  # cache, no default cmd
+
+
+class TestIndependentAggregation:
+    def test_merge_supervision_dedups_shared_dicts(self):
+        from jepsen_tpu.independent import _merge_supervision
+
+        shared = {"demotions": 1, "per_engine": {"pallas": {"oom": 1}}}
+        distinct = {"demotions": 2, "retries": 1}
+        merged = _merge_supervision([
+            {"valid": True, "supervision": shared},
+            {"valid": True, "supervision": shared},  # same object: once
+            {"valid": True, "supervision": distinct},
+            {"valid": True},
+        ])
+        assert merged == {"demotions": 3, "retries": 1,
+                          "per_engine": {"pallas": {"oom": 1}}}
+
+    def test_independent_checker_surfaces_supervision(self):
+        from jepsen_tpu import independent
+
+        test = {"model": MODEL}
+        hist = []
+        for k in ("a", "b"):
+            for o in _history():
+                hist.append(o.with_(value=independent.tuple_(k, o.value)))
+        for i, o in enumerate(hist):
+            o.index = i
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 99)
+        sup_mod._reset_for_tests(supervisor(
+            {"pallas": flaky, "host": host_batch}, max_retries=0))
+        chk = independent.checker(Linearizable(algorithm="pallas"))
+        r = chk.check(test, hist, {})
+        assert r["valid"] is True
+        assert r["supervision"]["demotions"] >= 1
+
+
+class TestFlakyEngine:
+    def test_schedule_and_log(self):
+        flaky = FlakyEngine(host_batch, schedule=["fail", None])
+        ess = [make_entries(_history())]
+        with pytest.raises(RuntimeError):
+            flaky(MODEL, ess)
+        assert flaky(MODEL, ess)[0].valid is True
+        assert flaky(MODEL, ess)[0].valid is True  # past schedule: clean
+        assert flaky.calls == 3
+        assert flaky.log == [("fail", 1), (None, 1), (None, 1)]
+
+    def test_thread_safe_counting(self):
+        flaky = FlakyEngine(host_batch, schedule=[])
+        ess = [make_entries(_history())]
+        threads = [threading.Thread(target=flaky, args=(MODEL, ess))
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert flaky.calls == 8

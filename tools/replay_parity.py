@@ -76,6 +76,7 @@ class Tally:
         self.matched = 0
         self.mismatches: list = []
         self.skipped = 0
+        self.failures = 0  # engine call raised — counted, not fatal
         self.wall_s = 0.0
 
     def record(self, case, got, allow_unknown: bool) -> None:
@@ -91,12 +92,26 @@ class Tally:
                 {"case": case["name"], "expected": exp,
                  "got": got if isinstance(got, (bool, str)) else str(got)})
 
+    def attempt(self, fn):
+        """Run one engine call; a raise is a counted failure (the case
+        scores as skipped, the replay carries on) rather than an abort
+        — the parity question is 'does it CONTRADICT', and a crash
+        doesn't, but it must show in PARITY.json."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            self.failures += 1
+            self.skipped += 1
+            log(f"  {self.name}: engine call failed ({e!r}); counted")
+            return None
+
     def summary(self) -> dict:
         return {
             "checked": self.checked,
             "matched": self.matched,
             "mismatches": self.mismatches,
             "skipped": self.skipped,
+            "failures": self.failures,
             "wall_s": round(self.wall_s, 1),
         }
 
@@ -112,16 +127,19 @@ def replay_host(cases, MODELS) -> Tally:
         hist = to_ops(case["history"])
         if case["expected"] == "unknown":
             budget = case["params"]["budget"]
-            r = wgl_host.analysis(model, hist,
-                                  max_steps=budget["max_steps"])
-            t.record(case, r.valid, allow_unknown=False)
+            r = t.attempt(lambda: wgl_host.analysis(
+                model, hist, max_steps=budget["max_steps"]))
+            if r is not None:
+                t.record(case, r.valid, allow_unknown=False)
             continue
-        r = wgl_host.analysis(model, hist, max_steps=5_000_000)
+        r = t.attempt(lambda: wgl_host.analysis(
+            model, hist, max_steps=5_000_000))
         # "linear" in the recorded oracle: WGL exhausted its
         # generation-time budget and linear decided — unknown is
         # permissible, contradiction is not.
-        t.record(case, r.valid,
-                 allow_unknown="linear" in case["oracle"])
+        if r is not None:
+            t.record(case, r.valid,
+                     allow_unknown="linear" in case["oracle"])
     t.wall_s = time.monotonic() - t0
     return t
 
@@ -137,18 +155,20 @@ def replay_linear(cases, MODELS) -> Tally:
         hist = to_ops(case["history"])
         if case["expected"] == "unknown":
             budget = case["params"]["budget"]
-            r = linear.analysis(model, hist,
-                                max_configs=budget["max_configs"])
-            t.record(case, r.valid, allow_unknown=False)
+            r = t.attempt(lambda: linear.analysis(
+                model, hist, max_configs=budget["max_configs"]))
+            if r is not None:
+                t.record(case, r.valid, allow_unknown=False)
             continue
         large = bool(case["params"].get("large")) or len(hist) >= 512
         # full-budget linear on the 512-1024-event cases costs minutes
         # per case; reduced budget + non-contradiction there (mirrors
         # tests/test_parity_corpus.py::test_linear_parity)
-        r = linear.analysis(model, hist,
-                            max_configs=30_000 if large else 300_000)
-        t.record(case, r.valid,
-                 allow_unknown=large or "wgl" in case["oracle"])
+        r = t.attempt(lambda: linear.analysis(
+            model, hist, max_configs=30_000 if large else 300_000))
+        if r is not None:
+            t.record(case, r.valid,
+                     allow_unknown=large or "wgl" in case["oracle"])
     t.wall_s = time.monotonic() - t0
     return t
 
@@ -172,13 +192,16 @@ def replay_native(cases, MODELS) -> Tally | None:
             continue
         if case["expected"] == "unknown":
             budget = case["params"]["budget"]
-            r = wgl_native.analysis(model, hist,
-                                    max_steps=budget["max_steps"])
-            t.record(case, r.valid, allow_unknown=False)
+            r = t.attempt(lambda: wgl_native.analysis(
+                model, hist, max_steps=budget["max_steps"]))
+            if r is not None:
+                t.record(case, r.valid, allow_unknown=False)
             continue
-        r = wgl_native.analysis(model, hist, max_steps=5_000_000)
-        t.record(case, r.valid,
-                 allow_unknown="linear" in case["oracle"])
+        r = t.attempt(lambda: wgl_native.analysis(
+            model, hist, max_steps=5_000_000))
+        if r is not None:
+            t.record(case, r.valid,
+                     allow_unknown="linear" in case["oracle"])
     t.wall_s = time.monotonic() - t0
     return t
 
@@ -234,7 +257,11 @@ def replay_tpu(cases, MODELS, on_tpu: bool) -> Tally:
     t0 = time.monotonic()
     for model_name, pairs in by_model.items():
         model = MODELS[model_name]()
-        results = wgl_tpu.analysis_batch(model, [es for _, es in pairs])
+        results = t.attempt(
+            lambda: wgl_tpu.analysis_batch(model, [es for _, es in pairs]))
+        if results is None:  # whole per-model batch failed: one failure,
+            t.skipped += len(pairs) - 1  # every lane of it skipped
+            continue
         for (case, _), r in zip(pairs, results):
             t.record(case, r.valid, allow_unknown=False)
     t.wall_s = time.monotonic() - t0
@@ -250,8 +277,12 @@ def replay_pallas(cases, MODELS, on_tpu: bool) -> Tally:
     t0 = time.monotonic()
     for model_name, pairs in by_model.items():
         model = MODELS[model_name]()
-        results = wgl_pallas_vec.analysis_batch(
-            model, [es for _, es in pairs])
+        results = t.attempt(
+            lambda: wgl_pallas_vec.analysis_batch(
+                model, [es for _, es in pairs]))
+        if results is None:
+            t.skipped += len(pairs) - 1
+            continue
         for (case, _), r in zip(pairs, results):
             t.record(case, r.valid, allow_unknown=False)
     t.wall_s = time.monotonic() - t0
@@ -293,12 +324,22 @@ def main(argv=None) -> int:
         log(f"  {name}: {engines[name]}")
 
     ok = all(not e.get("mismatches") for e in engines.values())
+    # supervision telemetry (per-engine failure kinds, demotions,
+    # breaker trips) for any checks that routed through the supervisor
+    # during the replay — zeros on a healthy run
+    try:
+        from jepsen_tpu.checker import supervisor as _sup
+
+        supervision = _sup.get().telemetry.snapshot()
+    except Exception:  # noqa: BLE001
+        supervision = None
     out = {
         "backend": platform,
         "interpret": not on_tpu,  # pallas emulation mode off-TPU
         "corpus": os.path.relpath(CORPUS, ROOT),
         "corpus_size": len(cases),
         "engines": engines,
+        "supervision": supervision,
         "ok": ok,
     }
     with open(args.out, "w") as fh:
