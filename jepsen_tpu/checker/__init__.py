@@ -9,6 +9,27 @@ validities with false > unknown > true dominance (checker.clj:26-47).
 
 test is the test map (jepsen's immutable test map, core.clj:540-560);
 opts may carry {"subdirectory": ...} for file-writing checkers.
+
+Checker registry
+----------------
+`REGISTRY` maps the names the CLI's --checker flag accepts to zero-arg
+factories, resolved uniformly by `resolve(name)`:
+
+  linearizable   single-register linearizability via the supervised
+                 WGL engine ladder (checker/linearizable.py)
+  cycle          Elle-style transactional cycle checker — dependency
+                 inference + Adya G0/G1c/G-single/G2 classification
+                 via matrix closure on the closure-engine ladder
+                 (checker/cycle/)
+  timeline       render the history as an HTML timeline
+  clock          clock-skew plot
+  perf           latency/rate graphs
+  recovery       nemesis fault/recovery audit
+  unbridled-optimism  everything is awesome (a no-op baseline)
+
+Workload-specific checkers (bank's SI total, long_fork's fork finder,
+adya's G2 counter) come from their workload bundles; the transactional
+three route through `cycle` internally.
 """
 
 from __future__ import annotations
@@ -118,15 +139,43 @@ from .perf import (  # noqa: E402
     rate_graph_checker as rate_graph,
 )
 from .recovery import RecoveryChecker, recovery  # noqa: E402
+# the cycle subsystem imports Checker from this package, so it loads
+# after the base protocol is defined (same pattern as the re-exports)
+from . import cycle  # noqa: E402
+
+# --checker names -> zero-arg checker factories (see module docstring)
+REGISTRY = {
+    "linearizable": linearizable,
+    "cycle": cycle.checker,
+    "timeline": timeline_html,
+    "clock": clock_plot,
+    "perf": perf_checker,
+    "recovery": recovery,
+    "unbridled-optimism": unbridled_optimism,
+}
+
+
+def resolve(name: str) -> Checker:
+    """Instantiate a registered checker by CLI name."""
+    try:
+        factory = REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown checker {name!r}; known: {sorted(REGISTRY)}"
+        ) from None
+    return factory()
+
 
 __all__ = [
     "Checker",
+    "REGISTRY",
     "RecoveryChecker",
     "check_safe",
     "clock_plot",
     "compose",
     "concurrency_limit",
     "counter",
+    "cycle",
     "latency_graph",
     "linearizable",
     "merge_valid",
@@ -134,6 +183,7 @@ __all__ = [
     "queue",
     "rate_graph",
     "recovery",
+    "resolve",
     "set_checker",
     "set_full",
     "timeline_html",

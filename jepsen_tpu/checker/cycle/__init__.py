@@ -1,0 +1,145 @@
+"""Elle-style transactional cycle checker.
+
+The transactional counterpart to checker/linearizable: instead of
+searching for a linearization, infer the dependency graph the observed
+values force (deps.py — ww/wr/rw/realtime relations, list-append and
+rw-register inference) and look for cycles, classified into Adya's
+anomalies (anomalies.py — G0/G1c/G-single/G2) via boolean matrix
+closure on the supervised engine ladder (ops/closure_tpu.py repeated
+squaring -> ops/closure_host.py DFS; checker/supervisor.py
+CLOSURE_LADDER).
+
+Usage::
+
+    from jepsen_tpu.checker import cycle
+    test["checker"] = cycle.checker(anomalies=["G1c", "G-single"])
+
+A result looks like::
+
+    {"valid": False, "anomaly-types": ["G-single"],
+     "anomalies": {"G-single": [{"cycle": [3, 7, 3], "steps": [
+         {"from": 3, "to": 7, "rel": "rw"},
+         {"from": 7, "to": 3, "rel": "wr"}], ...}]},
+     "cycle-count": 1, "node-count": 120, "component-count": 40}
+
+"valid" is False iff any requested anomaly has a cycle; inference
+failures (non-prefix reads, duplicate writes, phantom values) degrade
+to "unknown" with the offending detail under "error".
+"""
+
+from __future__ import annotations
+
+from .. import Checker
+from ...history import ops as _ops
+from ...independent import is_tuple
+from . import deps as _deps
+from .anomalies import ANOMALIES, classify
+from .deps import DepGraph, IllegalInference, extract
+
+__all__ = [
+    "ANOMALIES",
+    "CycleChecker",
+    "DepGraph",
+    "IllegalInference",
+    "checker",
+    "classify",
+    "extract",
+]
+
+
+class CycleChecker(Checker):
+    """Dependency-cycle checker over transactional histories.
+
+    anomalies      which Adya anomalies fail the history
+    version_order  register-key version order assumption
+                   ("write-once" or "value"; list-append keys always
+                   recover their order from read prefixes)
+    init_values    extra values reads of the initial version may show
+                   (e.g. (0,) for the causal counter registers)
+    realtime       also infer realtime edges and allow them in cycles
+                   (strict serializability flavor)
+    engine         None -> supervised closure ladder (the default);
+                   "host"/"tpu" pin one engine (parity tooling, bench)
+    """
+
+    def __init__(self, anomalies=ANOMALIES, *, version_order="write-once",
+                 init_values=(), realtime=False, engine=None,
+                 max_witnesses=4):
+        for a in anomalies:
+            if a not in ANOMALIES:
+                raise ValueError(
+                    f"unknown anomaly {a!r} (known: {ANOMALIES})")
+        self.anomalies = tuple(anomalies)
+        self.version_order = version_order
+        self.init_values = tuple(init_values)
+        self.realtime = realtime
+        self.engine = engine
+        self.max_witnesses = max_witnesses
+
+    def graph(self, history, key=None) -> DepGraph:
+        """The inferred dependency graph (exposed for tests/tools)."""
+        return extract(
+            history, key=key, version_order=self.version_order,
+            init_values=self.init_values, realtime=self.realtime)
+
+    def check(self, test, history, opts=None) -> dict:
+        from .. import supervisor as sup_mod
+
+        opts = opts or {}
+        history = [self._unwrap(o) for o in _ops(history)]
+        sup = sup_mod.get_closure()
+        snap0 = sup.telemetry.snapshot()
+        try:
+            g = self.graph(history, key=opts.get("history_key"))
+            r = classify(g, self.anomalies, realtime=self.realtime,
+                         engine=self.engine,
+                         max_witnesses=self.max_witnesses)
+        except IllegalInference as e:
+            return {"valid": "unknown", "error": e.info}
+        out = {"valid": not r["anomaly-types"], **r}
+        delta = sup_mod.Telemetry.delta(snap0, sup.telemetry.snapshot())
+        if any(k != "calls" for k in delta):
+            out["supervision"] = delta
+        self._render_invalid(test, history, out, opts)
+        return out
+
+    @staticmethod
+    def _render_invalid(test, history, result, opts) -> None:
+        """On a falsified history with a store attached, write a
+        timeline with the witness cycles drawn as relation-labeled
+        arrows (checker/timeline.py) — the transactional analogue of
+        the linearizable checker's counterexample rendering."""
+        if result["valid"] is not False:
+            return
+        if not (test and test.get("name") and test.get("start_time")):
+            return
+        try:
+            from ... import store
+            from .. import timeline
+
+            ws = [w for ws in result["anomalies"].values() for w in ws]
+            doc = timeline.render(test, history, witness=ws)
+            p = store.path_(
+                test, list((opts or {}).get("subdirectory") or []),
+                "timeline-cycle.html")
+            with open(p, "w") as f:
+                f.write(doc)
+        except Exception:  # noqa: BLE001 — rendering is best-effort
+            pass
+
+    @staticmethod
+    def _unwrap(o):
+        """Unwrap KVTuple txn values when used OUTSIDE independent's
+        sharding (a global run over a keyed history): namespace every
+        micro-op key with the tuple key so inference stays per-key."""
+        v = o.value
+        if not is_tuple(v) or not isinstance(v.value, (list, tuple)):
+            return o
+        if not all(_deps.mop.is_op(m) for m in v.value):
+            return o
+        return o.with_(value=[[m[0], (v.key, m[1]), m[2]]
+                              for m in v.value])
+
+
+def checker(anomalies=ANOMALIES, **kw) -> CycleChecker:
+    return CycleChecker(anomalies, **kw)

@@ -1,0 +1,199 @@
+"""Adya anomaly classification over a dependency graph.
+
+Given the relation matrices from deps.extract, each anomaly is a cycle
+shape, detected by masking WHICH relations may participate (Adya's
+taxonomy, via Elle):
+
+  G0        cycle of ww edges only (write cycle)
+  G1c       cycle of ww|wr edges with at least one wr (circular
+            information flow)
+  G-single  cycle with exactly one rw edge (read skew / SI's
+            characteristic anomaly)
+  G2        cycle with two or more rw edges (anti-dependency cycle)
+
+Detection reduces to transitive closure: an edge a -r-> b lies on a
+qualifying cycle iff b reaches a through the allowed mask —
+
+  G0 hits        ww  & closure(ww).T
+  G1c hits       wr  & closure(ww|wr).T
+  G-single hits  rw  & closure(ww|wr).T      (the return path has no
+                                              rw, so the cycle has
+                                              exactly one)
+  G2 hits        rw  & closure(ww|wr|rw).T   minus G-single hits
+
+With realtime in play (strict-serializability checking), the realtime
+relation is simply OR-ed into every mask.
+
+The closure itself is the expensive step, and it runs behind the
+closure-engine supervisor (checker/supervisor.py get_closure): the
+graph is first split into weakly-connected components — cycles cannot
+cross components, and per-key sharding (independent.py) makes many
+small components the common case (P-compositionality) — and every
+component x mask matrix goes to the device in ONE supervised batch,
+so watchdogs, circuit breakers, and TPU->host demotion apply
+unchanged. Witness recovery (a concrete shortest cycle per anomaly,
+for the report and the timeline) is host BFS on the tiny flagged
+component.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...ops import closure_host
+from .deps import DepGraph
+
+ANOMALIES = ("G0", "G1c", "G-single", "G2")
+
+# anomaly -> (relations allowed in the cycle, relation the hit edge
+# must carry)
+_MASKS = {
+    "G0": (("ww",), "ww"),
+    "G1c": (("ww", "wr"), "wr"),
+    "G-single": (("ww", "wr"), "rw"),
+    "G2": (("ww", "wr", "rw"), "rw"),
+}
+
+
+def components(full: np.ndarray) -> list:
+    """Weakly-connected components of the union graph, as index
+    arrays; singletons without a self-loop are dropped (no cycle can
+    involve them)."""
+    n = full.shape[0]
+    und = full | full.T
+    label = np.full(n, -1, dtype=np.int64)
+    comps: list = []
+    for s in range(n):
+        if label[s] >= 0:
+            continue
+        stack = [s]
+        label[s] = len(comps)
+        members = [s]
+        while stack:
+            u = stack.pop()
+            for v in np.flatnonzero(und[u]):
+                if label[v] < 0:
+                    label[v] = len(comps)
+                    members.append(int(v))
+                    stack.append(int(v))
+        comps.append(np.array(sorted(members), dtype=np.int64))
+    return [c for c in comps
+            if len(c) > 1 or full[c[0], c[0]]]
+
+
+def _closures(mats, engine=None) -> list:
+    """Closure of every matrix, through the supervised ladder by
+    default or a pinned engine ("host" / "tpu") for parity tooling."""
+    if not mats:
+        return []
+    if engine == "host":
+        return closure_host.reach_batch(mats)
+    if engine == "tpu":
+        from ...ops import closure_tpu
+
+        return closure_tpu.reach_batch(mats)
+    from .. import supervisor as sup_mod
+
+    sup = sup_mod.get_closure()
+    return sup.run(None, mats, ladder=sup_mod.CLOSURE_LADDER,
+                   on_exhausted="raise")
+
+
+def _witness(g: DepGraph, comp, allowed, a, b) -> dict:
+    """A concrete cycle through edge a -> b: the edge plus the
+    shortest b -> a path inside the allowed-mask subgraph of one
+    component (host BFS). Returns op indices + relation labels, the
+    shape checker/timeline.py renders."""
+    sub = allowed[np.ix_(comp, comp)]
+    la = int(np.searchsorted(comp, a))
+    lb = int(np.searchsorted(comp, b))
+    path = closure_host.shortest_cycle_path(sub, lb, la)
+    if path is None:  # can't happen if the closure was sound; degrade
+        path = [lb, la]
+    nodes = [a] + [int(comp[i]) for i in path]
+    steps = []
+    for u, v in zip(nodes, nodes[1:]):
+        rels = g.rels_of(u, v)
+        steps.append({
+            "from": int(g.ops[u].index),
+            "to": int(g.ops[v].index),
+            "rel": rels[0] if rels else "?",
+        })
+    return {
+        "cycle": [int(g.ops[i].index) for i in nodes],
+        "steps": steps,
+        "ops": [g.ops[i] for i in nodes[:-1]],
+    }
+
+
+def classify(g: DepGraph, anomalies=ANOMALIES, *, realtime=False,
+             engine=None, max_witnesses=4) -> dict:
+    """Find every requested anomaly in a dependency graph.
+
+    Returns {"anomaly-types": [...], "anomalies": {type: [witness]},
+    "cycle-count": int, "node-count": int, "component-count": int}.
+    Witness lists are capped at max_witnesses per type; the hit COUNT
+    (cycle-count) is exact."""
+    for a in anomalies:
+        if a not in _MASKS:
+            raise ValueError(f"unknown anomaly {a!r} "
+                             f"(known: {ANOMALIES})")
+    anomalies = [a for a in ANOMALIES if a in anomalies]
+    n = len(g)
+    base = ("realtime",) if realtime and "realtime" in g.adj else ()
+    # every distinct relation mask we need a closure of
+    masks = {}
+    for a in anomalies:
+        rels = tuple(_MASKS[a][0]) + base
+        masks.setdefault(rels, g.union(rels))
+    full = g.union(("ww", "wr", "rw") + base)
+    comps = components(full)
+    # one supervised batch: |components| x |distinct masks| closures
+    keys = list(masks)
+    jobs = [(rels, c) for rels in keys for c in comps]
+    closed = _closures([masks[rels][np.ix_(c, c)] for rels, c in jobs],
+                       engine=engine)
+    # reassemble per-mask full-size closure (block-diagonal by
+    # construction: no path leaves a weak component)
+    closure = {rels: np.zeros((n, n), dtype=bool) for rels in keys}
+    for (rels, c), sub in zip(jobs, closed):
+        closure[rels][np.ix_(c, c)] = sub
+    found: dict = {}
+    types: list = []
+    cycles = 0
+    claimed = np.zeros((n, n), dtype=bool)  # G-single hits, for G2 dedup
+    for a in anomalies:
+        rels, hit_rel = _MASKS[a]
+        allowed = masks[tuple(rels) + base]
+        cl = closure[tuple(rels) + base]
+        hits = g.adj[hit_rel] & cl.T
+        if a == "G-single":
+            claimed |= hits
+        elif a == "G2":
+            # when G-single also ran, its hits are the exactly-one-rw
+            # cycles; without it, G2 keeps Adya's broad sense (>= 1 rw)
+            hits = hits & ~claimed
+        k = int(hits.sum())
+        if not k:
+            continue
+        cycles += k
+        types.append(a)
+        ws = []
+        ii, jj = np.nonzero(hits)
+        for x, y in list(zip(ii, jj))[:max_witnesses]:
+            x, y = int(x), int(y)
+            comp = next(c for c in comps if x in c)
+            # the return path b -> a stays inside the allowed mask (the
+            # closure proved it exists there); the hit edge itself is
+            # prepended from the real adjacency
+            w = _witness(g, comp, allowed, x, y)
+            w["type"] = a
+            ws.append(w)
+        found[a] = ws
+    return {
+        "anomaly-types": types,
+        "anomalies": found,
+        "cycle-count": cycles,
+        "node-count": n,
+        "component-count": len(comps),
+    }

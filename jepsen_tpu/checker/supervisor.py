@@ -325,6 +325,69 @@ def default_registry() -> dict:
     }
 
 
+# -- closure engines (checker/cycle) ----------------------------------------
+#
+# The cycle checker's reachability engines ride the same supervision
+# machinery — watchdog, retry, breaker, OOM bisection, ladder salvage —
+# through a SECOND singleton with its own registry: the work unit is a
+# list of adjacency matrices, not (model, entries), and the rung names
+# must not collide with the search engines' (probe_engine and the
+# breaker key by name). `model` is unused and passed as None.
+
+CLOSURE_LADDER = ("closure_tpu", "closure_host")
+
+
+def _run_closure_tpu(model, adjs, max_steps=None, time_limit=None):
+    from ..ops import closure_tpu
+
+    return closure_tpu.reach_batch(adjs, max_steps=max_steps,
+                                   time_limit=time_limit)
+
+
+def _run_closure_host(model, adjs, max_steps=None, time_limit=None):
+    from ..ops import closure_host
+
+    return closure_host.reach_batch(adjs, max_steps=max_steps,
+                                    time_limit=time_limit)
+
+
+# Off-TPU, the XLA squaring engine emulates log2(n) dense matmuls on
+# the host — strictly worse than the DFS floor beyond small matrices
+# (bench.py cycle_closure measures the real crossover on TPU hosts).
+# Eligibility caps its CPU use so big components route straight to
+# closure_host without counting as degradation.
+CLOSURE_CPU_MAX_N = 256
+
+
+def _elig_closure_tpu(model, adjs) -> bool:
+    try:
+        from ..ops import closure_tpu  # noqa: F401 — jax import
+    except ImportError:
+        return False
+    try:
+        import jax
+
+        if jax.devices()[0].platform == "tpu":
+            return True
+    except Exception:  # noqa: BLE001 — no usable backend
+        return False
+    return all(a.shape[0] <= CLOSURE_CPU_MAX_N for a in adjs)
+
+
+def closure_registry() -> dict:
+    return {
+        "closure_tpu": _run_closure_tpu,
+        "closure_host": _run_closure_host,
+    }
+
+
+def closure_eligibility() -> dict:
+    return {
+        "closure_tpu": _elig_closure_tpu,
+        "closure_host": lambda model, adjs: True,
+    }
+
+
 def _elig_pallas(model, ess) -> bool:
     from ..models import jit as mjit
 
@@ -703,3 +766,29 @@ def _reset_for_tests(sup: Supervisor | None = None) -> None:
     global _supervisor
     with _lock:
         _supervisor = sup
+
+
+_closure_supervisor: Supervisor | None = None
+
+
+def get_closure() -> Supervisor:
+    """The process-wide supervisor for the cycle checker's closure
+    engines. Separate from get(): different registry/eligibility, its
+    own breaker state, and callers run with ladder=CLOSURE_LADDER +
+    on_exhausted="raise" (the "unknown" placeholder path fabricates
+    WGL results, which are the wrong type for closures — check_safe
+    upstream turns the raise into an unknown verdict instead)."""
+    global _closure_supervisor
+    with _lock:
+        if _closure_supervisor is None:
+            _closure_supervisor = Supervisor(
+                _env_config(), registry=closure_registry(),
+                eligibility=closure_eligibility())
+        return _closure_supervisor
+
+
+def _reset_closure_for_tests(sup: Supervisor | None = None) -> None:
+    """Swap/clear the closure singleton (test hook)."""
+    global _closure_supervisor
+    with _lock:
+        _closure_supervisor = sup

@@ -80,14 +80,59 @@ def _process_index(history) -> dict:
     return idx
 
 
-def render(test, history, end_time_nanos=None) -> str:
-    """The full HTML document (timeline.clj:123-157)."""
+#: witness-arrow stroke per dependency relation (checker/cycle)
+REL_COLORS = {"ww": "#C62828", "wr": "#1565C0", "rw": "#EF6C00",
+              "realtime": "#555555"}
+
+
+def _witness_svg(witness, pos, width, height) -> str:
+    """An absolutely-positioned SVG overlay drawing each witness-cycle
+    edge as an op -> op arrow labeled with its relation. `witness` is
+    a list of cycle-checker witness dicts ({"steps": [{"from": index,
+    "to": index, "rel": ...}]}); `pos` maps op index -> box center."""
+    lines = []
+    for w in witness or []:
+        for s in w.get("steps", []):
+            a, b = pos.get(s.get("from")), pos.get(s.get("to"))
+            if a is None or b is None:
+                continue
+            rel = str(s.get("rel", "?"))
+            color = REL_COLORS.get(rel, "#000000")
+            (x1, y1), (x2, y2) = a, b
+            mx, my = (x1 + x2) / 2, (y1 + y2) / 2
+            lines.append(
+                f'<line x1="{x1:.0f}" y1="{y1:.1f}" x2="{x2:.0f}" '
+                f'y2="{y2:.1f}" stroke="{color}" stroke-width="2" '
+                f'marker-end="url(#arrow)"/>'
+                f'<text x="{mx:.0f}" y="{my:.1f}" fill="{color}" '
+                f'font-size="11" font-family="sans-serif">'
+                f"{html_mod.escape(rel)}</text>"
+            )
+    if not lines:
+        return ""
+    return (
+        f'<svg class="witness" width="{width:.0f}" '
+        f'height="{height:.0f}" style="position:absolute;left:0;top:0;'
+        f'pointer-events:none">'
+        '<defs><marker id="arrow" viewBox="0 0 10 10" refX="9" refY="5" '
+        'markerWidth="7" markerHeight="7" orient="auto-start-reverse">'
+        '<path d="M 0 0 L 10 5 L 0 10 z" fill="context-stroke"/>'
+        "</marker></defs>" + "".join(lines) + "</svg>"
+    )
+
+
+def render(test, history, end_time_nanos=None, witness=None) -> str:
+    """The full HTML document (timeline.clj:123-157). `witness` takes
+    cycle-checker witnesses (result["anomalies"] values flattened) and
+    overlays their dependency edges as labeled arrows."""
     procs = _process_index(history)
     times = [o.time for o in history if o.time is not None and o.time >= 0]
     t_end = end_time_nanos if end_time_nanos is not None else (
         max(times) if times else 0
     )
     divs = []
+    pos: dict = {}
+    max_bottom = 0.0
     for start, stop in op_pairs(history):
         if start.time is None or start.time < 0:
             continue
@@ -98,6 +143,13 @@ def render(test, history, end_time_nanos=None) -> str:
         top = start.time / TIMESCALE
         bottom = (stop.time if stop is not None else t_end) / TIMESCALE
         height = max(HEIGHT, bottom - top)
+        # either end of the op window addresses this box (cycle
+        # witnesses carry completion indices)
+        center = (left + COL_WIDTH / 2, top + height / 2)
+        pos[start.index] = center
+        if stop is not None:
+            pos.setdefault(stop.index, center)
+        max_bottom = max(max_bottom, top + height)
         label = f"{start.process} {start.f} {start.value!r}"
         divs.append(
             f'<div id="op-{start.index}" class="op {cls}" '
@@ -106,22 +158,25 @@ def render(test, history, end_time_nanos=None) -> str:
             f'title="{html_mod.escape(_title(start, stop), quote=True)}">'
             f"{html_mod.escape(label)}</div>"
         )
+    svg = _witness_svg(witness, pos, GUTTER * max(len(procs), 1),
+                       max_bottom + HEIGHT)
     name = html_mod.escape(str(test.get("name", "test")))
     return (
         "<!doctype html><html><head>"
         f"<title>{name} timeline</title>"
         f"<style>{STYLESHEET}</style></head><body>"
         f"<h1>{name}</h1>"
-        f'<div class="ops">{"".join(divs)}</div>'
+        f'<div class="ops">{"".join(divs)}{svg}</div>'
         "</body></html>"
     )
 
 
 class HtmlTimeline(Checker):
-    """Writes timeline.html (timeline.clj:159-179)."""
+    """Writes timeline.html (timeline.clj:159-179). opts["witness"]
+    (cycle-checker witnesses) overlays dependency-cycle arrows."""
 
     def check(self, test: Mapping, history, opts=None) -> dict:
-        doc = render(test, history)
+        doc = render(test, history, witness=(opts or {}).get("witness"))
         if test.get("name") and test.get("start_time"):
             from .. import store
 

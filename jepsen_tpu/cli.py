@@ -118,6 +118,14 @@ def test_opt_spec(parser: argparse.ArgumentParser) -> None:
         help="Seed the composed nemesis package's RNG so the fault "
         "schedule is reproducible",
     )
+    # SUPPRESS for the same reason as --nemesis: absent means "keep
+    # the suite's checker", not "clobber it with None"
+    parser.add_argument(
+        "--checker", default=argparse.SUPPRESS, metavar="NAME",
+        help="Replace the suite's checker with a registered one "
+        "(jepsen_tpu.checker.REGISTRY): linearizable, cycle, "
+        "timeline, clock, perf, recovery, unbridled-optimism",
+    )
 
 
 def parse_concurrency(opts: dict, key: str = "concurrency") -> dict:
@@ -245,13 +253,24 @@ def main(subcommands: dict, argv: list[str] | None = None) -> None:
 # ---------------------------------------------------------------------------
 # Standard subcommands
 
+def _apply_checker(test_map: dict, opts: dict) -> dict:
+    """--checker NAME replaces the suite's checker with a registered
+    one (checker.resolve); absent leaves the suite's choice alone."""
+    name = opts.get("checker")
+    if isinstance(name, str):
+        from . import checker as checker_mod
+
+        test_map["checker"] = checker_mod.resolve(name)
+    return test_map
+
+
 def _run_test(test_fn, opts) -> int:
     """The `test` subcommand body (cli.clj:355-364): run --test-count
     times; exit 1 if any run's results are invalid."""
     from . import core
 
     for _ in range(int(opts.get("test_count", 1))):
-        test_map = test_fn(dict(opts))
+        test_map = _apply_checker(test_fn(dict(opts)), opts)
         if opts.get("store_dir"):
             test_map.setdefault("store_dir", opts["store_dir"])
         test = core.run(test_map)
@@ -269,7 +288,7 @@ def _run_analyze(test_fn, opts) -> int:
     no cluster needed."""
     from . import core, store
 
-    cli_test = test_fn(dict(opts))
+    cli_test = _apply_checker(test_fn(dict(opts)), opts)
     stored = store.latest(store_dir=opts.get("store_dir"))
     if stored is None:
         raise RuntimeError("Not sure what the last test was")

@@ -319,6 +319,14 @@ class IndependentChecker(Checker):
         sup = _merge_supervision(results.values())
         if sup:
             out["supervision"] = sup
+        # cycle-checker results: union the per-key anomaly taxonomy so
+        # the top level answers "which anomalies did ANY key show"
+        anomaly_types = sorted({
+            t for r in results.values() if isinstance(r, dict)
+            for t in r.get("anomaly-types") or ()
+        })
+        if anomaly_types:
+            out["anomaly-types"] = anomaly_types
         return out
 
     @staticmethod

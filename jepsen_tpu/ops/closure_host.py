@@ -1,0 +1,103 @@
+"""Transitive closure of boolean dependency graphs on the host.
+
+The cycle checker (checker/cycle) reduces Elle-style anomaly detection
+to reachability over ww/wr/rw adjacency matrices: a transaction sits on
+a dependency cycle iff it can reach itself through at least one edge.
+This module is the always-available floor of the closure engine ladder
+(checker/supervisor.py CLOSURE_LADDER): an iterative DFS per source
+node over adjacency lists — O(n·(n+e)), no third-party deps, and the
+semantics oracle the device engine (ops/closure_tpu.py) is
+parity-tested against.
+
+All closures here are *irreflexive-path* closures: ``reach[i, j]`` is
+True iff there is a path of length >= 1 from i to j, so ``reach[i, i]``
+marks a genuine cycle through i, never the trivial empty path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def reach(adj: np.ndarray) -> np.ndarray:
+    """Reachability-by-at-least-one-edge matrix of a dense boolean
+    adjacency matrix: out[i, j] iff a path i -> ... -> j with >= 1 edge
+    exists. Iterative DFS from every source over adjacency lists."""
+    a = np.asarray(adj, dtype=bool)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"adjacency must be square, got {a.shape}")
+    out = np.zeros((n, n), dtype=bool)
+    if n == 0:
+        return out
+    succs = [np.flatnonzero(a[i]).tolist() for i in range(n)]
+    for src in range(n):
+        seen = out[src]
+        # Seed with src's direct successors, then walk: standard
+        # explicit-stack DFS (no recursion limit at n=512+).
+        stack = [v for v in succs[src] if not seen[v]]
+        for v in stack:
+            seen[v] = True
+        while stack:
+            u = stack.pop()
+            for v in succs[u]:
+                if not seen[v]:
+                    seen[v] = True
+                    stack.append(v)
+    return out
+
+
+def reach_batch(adjs, max_steps=None, time_limit=None) -> list:
+    """Closure of each adjacency matrix in `adjs`, aligned with the
+    input. Signature matches the supervisor engine-runner convention
+    (checker/supervisor.py): budgets are accepted for uniformity — the
+    host walk is exact and terminates without them."""
+    return [reach(a) for a in adjs]
+
+
+def cyclic_nodes(reach_m: np.ndarray) -> np.ndarray:
+    """Indices of nodes lying on at least one cycle (diagonal of the
+    path closure)."""
+    return np.flatnonzero(np.diagonal(reach_m))
+
+
+def same_scc(reach_m: np.ndarray) -> np.ndarray:
+    """Pairwise strongly-connected-component membership: i and j share
+    an SCC iff each reaches the other (a node shares with itself only
+    when it is on a cycle — consistent with the irreflexive closure;
+    callers wanting reflexive SCCs OR in the identity)."""
+    return reach_m & reach_m.T
+
+
+def shortest_cycle_path(adj: np.ndarray, start: int, goal: int) -> list | None:
+    """Shortest path start -> goal over `adj` (BFS), as a node list
+    [start, ..., goal]; None when unreachable. With start == goal this
+    finds the shortest nontrivial cycle through the node. Used by the
+    anomaly classifier to recover a concrete witness cycle on the host
+    once the closure engines have flagged an SCC."""
+    a = np.asarray(adj, dtype=bool)
+    n = a.shape[0]
+    prev = np.full(n, -1, dtype=np.int64)
+    frontier = [int(v) for v in np.flatnonzero(a[start])]
+    for v in frontier:
+        prev[v] = start
+    visited = np.zeros(n, dtype=bool)
+    visited[frontier] = True
+    while frontier and not visited[goal]:
+        nxt = []
+        for u in frontier:
+            for v in np.flatnonzero(a[u]):
+                if not visited[v]:
+                    visited[v] = True
+                    prev[v] = u
+                    nxt.append(int(v))
+        frontier = nxt
+    if not visited[goal]:
+        return None
+    path = [goal]
+    while path[-1] != start or len(path) == 1:
+        p = int(prev[path[-1]])
+        path.append(p)
+        if p == start:
+            break
+    return path[::-1]

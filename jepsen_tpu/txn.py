@@ -9,6 +9,7 @@ from __future__ import annotations
 
 READ = "r"
 WRITE = "w"
+APPEND = "append"  # list-append workloads (Elle's richest inference)
 
 
 def f(mop):
@@ -34,9 +35,13 @@ def is_write(mop) -> bool:
     return f(mop) == WRITE
 
 
+def is_append(mop) -> bool:
+    return f(mop) == APPEND
+
+
 def is_op(mop) -> bool:
     """Is this a legal micro-op (micro_op.clj:29-33)?"""
     try:
-        return len(mop) == 3 and f(mop) in (READ, WRITE)
+        return len(mop) == 3 and f(mop) in (READ, WRITE, APPEND)
     except TypeError:
         return False

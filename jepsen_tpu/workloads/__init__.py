@@ -4,4 +4,11 @@ consistency tests, mirroring the reference's jepsen.tests.* namespaces
 builder returning a partial test map — callers supply the client and DB.
 """
 
-from . import adya, bank, causal, linearizable_register, long_fork  # noqa: F401
+from . import (  # noqa: F401
+    adya,
+    bank,
+    causal,
+    linearizable_register,
+    list_append,
+    long_fork,
+)

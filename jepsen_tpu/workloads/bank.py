@@ -184,11 +184,23 @@ def plotter() -> BankPlotter:
 
 def test() -> dict:
     """Partial test bundle: defaults + generator + checkers
-    (bank.clj:169-178)."""
+    (bank.clj:169-178).
+
+    The "cycle" entry runs the transactional cycle checker
+    (jepsen_tpu.checker.cycle) alongside the SI total check. Bank ops
+    carry aggregate snapshots ({account: balance}) rather than micro-op
+    transactions, so dependency inference sees no attributable
+    versions and the entry is vacuously true on this value shape — it
+    is wired here so a client recording micro-op transfer txns
+    ([["r", acct, bal], ["w", acct, bal']], unique balances) gets
+    G0/G1c/G-single/G2 classification with no further changes."""
+    from ..checker import cycle
+
     return {
         "max_transfer": 5,
         "total_amount": 100,
         "accounts": list(range(8)),
-        "checker": Compose({"SI": checker(), "plot": plotter()}),
+        "checker": Compose({"SI": checker(), "plot": plotter(),
+                            "cycle": cycle.checker()}),
         "generator": generator(),
     }

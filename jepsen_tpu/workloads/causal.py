@@ -115,9 +115,19 @@ def test(opts: dict) -> dict:
             {"type": "info", "f": "stop"},
         ]
     )
+    from ..checker import Compose, cycle
+
     return {
         "model": causal_register(),
-        "checker": independent.checker(check()),
+        # per key: the sequential causal replay, plus the cycle
+        # checker under value-ordered rw-register inference (writes
+        # are the counter values 1, 2, ...; reads may see the initial
+        # 0) — circular causality shows up as a G1c/G-single cycle
+        "checker": independent.checker(Compose({
+            "causal": check(),
+            "cycle": cycle.checker(version_order="value",
+                                   init_values=(0,)),
+        })),
         "generator": gen.time_limit(
             opts.get("time_limit", 60),
             gen.nemesis(

@@ -8,9 +8,12 @@ Every key is written at most once, so per-key states move nil -> v and
 read snapshots within a key group form a partial order by domination; the
 checker verifies this order is total.
 
-Array path: groups of reads are compared all-pairs via numpy broadcasting
-over the nil-mask (a read dominates another iff its non-nil set is a
-strict superset), instead of the reference's pairwise reduce."""
+Checking routes through the transactional cycle checker
+(jepsen_tpu.checker.cycle): a long fork is a dependency cycle with two
+anti-dependency edges, so the pairwise-domination test reduces to
+cycle detection under write-once rw-register inference. The vectorized
+all-pairs comparator (find_forks) survives one release behind
+checker(n, legacy=True)."""
 
 from __future__ import annotations
 
@@ -99,29 +102,6 @@ class LongForkGen(gen.Generator):
 
 def generator(n: int) -> LongForkGen:
     return LongForkGen(n)
-
-
-def read_compare(a: dict, b: dict):
-    """-1 if a dominates, 0 if equal, 1 if b dominates, None if
-    incomparable (long_fork.clj:158-196)."""
-    if set(a.keys()) != set(b.keys()):
-        raise IllegalHistory(_MSG_KEY_MISMATCH, reads=[a, b])
-    res = 0
-    for k in a:
-        va, vb = a[k], b[k]
-        if va == vb:
-            continue
-        if vb is None:
-            if res > 0:
-                return None
-            res = -1
-        elif va is None:
-            if res < 0:
-                return None
-            res = 1
-        else:
-            raise IllegalHistory(_MSG_DISTINCT_VALUES, key=k, reads=[a, b])
-    return res
 
 
 def read_op_to_value_map(op) -> dict:
@@ -247,10 +227,20 @@ def late_reads(read_ops) -> list:
 
 class LongForkChecker(Checker):
     """No key written twice; no pair of reads observing conflicting write
-    orders (long_fork.clj:311-324)."""
+    orders (long_fork.clj:311-324).
 
-    def __init__(self, n: int):
+    The default path routes through the transactional cycle checker
+    (checker/cycle): every key is written once, so rw-register
+    inference under the write-once order applies, and a long fork IS a
+    dependency cycle — each of the two reads wr-depends on the write
+    it saw and rw-precedes the write it missed, closing a cycle with
+    two anti-dependencies (G2-class; any requested anomaly fails). The
+    pre-cycle pairwise-domination comparator survives one release
+    behind legacy=True."""
+
+    def __init__(self, n: int, legacy: bool = False):
         self.n = n
+        self.legacy = legacy
 
     def check(self, test, history, opts=None) -> dict:
         history = _ops(history)
@@ -263,7 +253,8 @@ class LongForkChecker(Checker):
         try:
             verdict = (
                 ensure_no_multiple_writes_to_one_key(history)
-                or ensure_no_long_forks(self.n, rs)
+                or (ensure_no_long_forks(self.n, rs) if self.legacy
+                    else self._cycle_verdict(test, history, rs, opts))
                 or {"valid": True}
             )
         except IllegalHistory as e:
@@ -271,9 +262,31 @@ class LongForkChecker(Checker):
         out.update(verdict)
         return out
 
+    def _cycle_verdict(self, test, history, rs, opts) -> dict | None:
+        from ..checker import cycle
 
-def checker(n: int) -> LongForkChecker:
-    return LongForkChecker(n)
+        # structural validation first: mismatched group sizes and
+        # twice-written values are uncheckable, same as the legacy path
+        groups(self.n, rs)
+        r = cycle.checker(version_order="write-once").check(
+            test, history, opts)
+        if r["valid"] is True:
+            return None
+        if r["valid"] is False:
+            # a long fork's witness cycle alternates reads and writes;
+            # the observing reads are the classic "forks" pair
+            forks = [
+                [o for o in w["ops"] if is_read_txn(o.value or [])]
+                for ws in r["anomalies"].values() for w in ws
+            ]
+            return {"valid": False, "forks": forks,
+                    "anomaly-types": r["anomaly-types"],
+                    "anomalies": r["anomalies"]}
+        return {"valid": "unknown", "error": r.get("error")}
+
+
+def checker(n: int, legacy: bool = False) -> LongForkChecker:
+    return LongForkChecker(n, legacy=legacy)
 
 
 def workload(n: int = 2) -> dict:

@@ -21,10 +21,16 @@ CHAPTERS = [
     "04-checker",
     "05-nemesis",
     "06-refining",
+    "06-cycles",
     "07-parameters",
     "08-set",
     "09-tpu-analysis",
 ]
+
+#: interlude chapters whose stage is a self-contained program rather
+#: than the next revision of etcdemo.py — executed like any chapter,
+#: but outside the monotone-progression contract
+STANDALONE = {"06-cycles"}
 
 
 def extract_stage(chapter: str) -> str:
@@ -71,6 +77,8 @@ class TestProgression:
         must keep (almost) every definition the prior one introduced."""
         prior: set = set()
         for ch in CHAPTERS:
+            if ch in STANDALONE:
+                continue
             src = extract_stage(ch)
             defs = set(re.findall(r"^(?:def|class) (\w+)", src, re.M))
             # chapter 6 swaps the single-key client for the

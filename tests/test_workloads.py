@@ -306,16 +306,25 @@ class TestLongFork:
         assert sorted(mop.key(m) for m in t) == [4, 5]
         assert all(mop.is_read(m) for m in t)
 
-    def test_read_compare(self):
-        rc = long_fork.read_compare
-        assert rc({0: 1, 1: None}, {0: 1, 1: None}) == 0
-        assert rc({0: 1, 1: 1}, {0: 1, 1: None}) == -1
-        assert rc({0: 1, 1: None}, {0: 1, 1: 1}) == 1
-        assert rc({0: 1, 1: None}, {0: None, 1: 1}) is None
-        with pytest.raises(long_fork.IllegalHistory):
-            rc({0: 1}, {1: 1})
-        with pytest.raises(long_fork.IllegalHistory):
-            rc({0: 1, 1: 2}, {0: 1, 1: 3})
+    def test_legacy_path_matches_cycle_path(self):
+        # read_compare is gone; the legacy all-pairs comparator and
+        # the cycle-checker routing must agree on fork verdicts
+        h = [
+            _write(0, 0, type="invoke", index=0),
+            _write(0, 0, type="ok", index=1),
+            _write(1, 1, type="invoke", index=2),
+            _write(1, 1, type="ok", index=3),
+            _read(2, [(0, 1), (1, None)], index=4),
+            _read(3, [(0, None), (1, 1)], index=5),
+        ]
+        new = long_fork.checker(2).check({}, h)
+        old = long_fork.checker(2, legacy=True).check({}, h)
+        assert new["valid"] is old["valid"] is False
+        assert new["forks"] and old["forks"]
+        ok = h[:4] + [_read(2, [(0, 1), (1, None)], index=4)]
+        assert (long_fork.checker(2).check({}, ok)["valid"]
+                is long_fork.checker(2, legacy=True).check({}, ok)["valid"]
+                is True)
 
     def test_find_forks_classic(self):
         # T3 sees x only; T4 sees y only — the canonical long fork

@@ -22,10 +22,18 @@ each skip is COUNTED, never silent. An engine may return "unknown"
 where the recorded oracle notes the other algorithm decided; it may
 never contradict the expected verdict.
 
+The transactional cycle checker's closure engines (closure_host DFS /
+closure_tpu repeated squaring) replay too: seeded list-append histories
+— clean and with injected G1c/G-single anomalies — must produce
+IDENTICAL verdicts and anomaly taxonomies through both engines, and the
+raw closure matrices must agree exactly on seeded random digraphs.
+Their parity lands under "cycle" in the summary.
+
 Writes a machine-readable summary to PARITY.json at the repo root
 (backend, interpret flag, corpus size, per-engine
-checked/matched/mismatches/skipped) and exits 0 iff no engine
-contradicted any expected verdict.
+checked/matched/mismatches/skipped, cycle-engine anomaly parity) and
+exits 0 iff no engine contradicted any expected verdict and the cycle
+engines agreed throughout.
 
 Usage:  python tools/replay_parity.py  [--out PATH]
 """
@@ -289,6 +297,79 @@ def replay_pallas(cases, MODELS, on_tpu: bool) -> Tally:
     return t
 
 
+def replay_cycle(on_tpu: bool) -> dict:
+    """Anomaly-verdict parity for the transactional cycle checker
+    (checker/cycle): the same histories through the host-DFS and the
+    device-squaring closure engines must produce identical verdicts
+    AND identical anomaly taxonomies; the raw closure matrices must
+    agree bit-for-bit on seeded random digraphs. Off-TPU the "tpu"
+    engine runs the same XLA squaring kernel on the CPU backend —
+    weaker evidence than a device run (the `interpret`/backend fields
+    say which this was), but it still exercises the packed-bitmat
+    fixpoint path end to end."""
+    import numpy as np
+
+    from jepsen_tpu.checker import cycle
+    from jepsen_tpu.ops import closure_host, closure_tpu
+    from jepsen_tpu.workloads import list_append
+
+    t0 = time.monotonic()
+    out: dict = {"engines": ["closure_host", "closure_tpu"],
+                 "cases": 0, "matched": 0, "mismatches": [],
+                 "failures": 0, "digraphs": 0, "closure_mismatches": 0}
+
+    histories = []
+    for seed in (11, 42):
+        histories.append((f"list-append-600-clean-s{seed}",
+                          list_append.simulate(600, seed=seed, inject=())))
+        histories.append((
+            f"list-append-600-injected-s{seed}",
+            list_append.simulate(600, seed=seed,
+                                 inject=("G1c", "G-single"))))
+    # the acceptance shape: 5,000 ops, both anomalies injected — its
+    # giant weak component is the largest matrix the engines see here
+    histories.append((
+        "list-append-5k-acceptance",
+        list_append.simulate(5000, seed=7, inject=("G1c", "G-single"))))
+
+    def verdict(r) -> tuple:
+        return (r["valid"], tuple(r.get("anomaly-types") or ()))
+
+    for name, hist in histories:
+        out["cases"] += 1
+        try:
+            rh = cycle.checker(engine="host").check({}, hist, {})
+            rt = cycle.checker(engine="tpu").check({}, hist, {})
+        except Exception as e:  # noqa: BLE001 — counted, not fatal
+            out["failures"] += 1
+            log(f"  cycle: {name} failed ({e!r}); counted")
+            continue
+        if verdict(rh) == verdict(rt):
+            out["matched"] += 1
+        else:
+            out["mismatches"].append(
+                {"case": name, "host": list(verdict(rh)),
+                 "tpu": list(verdict(rt))})
+
+    # raw closure parity on random digraphs: odd sizes cross pad
+    # buckets, density sweeps from sparse DAG-ish to near-complete
+    for n, avg_deg, seed in ((3, 1.0, 1), (17, 2.0, 2), (33, 4.0, 3),
+                             (64, 8.0, 4), (129, 3.0, 5), (200, 5.0, 6),
+                             (256, 16.0, 7)):
+        rng = np.random.default_rng(seed)
+        a = rng.random((n, n)) < (avg_deg / n)
+        np.fill_diagonal(a, False)
+        out["digraphs"] += 1
+        if not np.array_equal(closure_host.reach(a), closure_tpu.reach(a)):
+            out["closure_mismatches"] += 1
+            log(f"  cycle: closure matrices disagree at n={n} seed={seed}")
+
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = (not out["mismatches"] and not out["failures"]
+                 and out["closure_mismatches"] == 0)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=os.path.join(ROOT, "PARITY.json"),
@@ -323,7 +404,12 @@ def main(argv=None) -> int:
         engines[name] = tl.summary()
         log(f"  {name}: {engines[name]}")
 
-    ok = all(not e.get("mismatches") for e in engines.values())
+    log("replaying cycle closure engines ...")
+    cycle_out = replay_cycle(on_tpu)
+    log(f"  cycle: {cycle_out}")
+
+    ok = (all(not e.get("mismatches") for e in engines.values())
+          and cycle_out["ok"])
     # supervision telemetry (per-engine failure kinds, demotions,
     # breaker trips) for any checks that routed through the supervisor
     # during the replay — zeros on a healthy run
@@ -339,6 +425,7 @@ def main(argv=None) -> int:
         "corpus": os.path.relpath(CORPUS, ROOT),
         "corpus_size": len(cases),
         "engines": engines,
+        "cycle": cycle_out,
         "supervision": supervision,
         "ok": ok,
     }
