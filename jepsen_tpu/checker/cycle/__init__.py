@@ -93,7 +93,8 @@ class CycleChecker(Checker):
             g = self.graph(history, key=opts.get("history_key"))
             r = classify(g, self.anomalies, realtime=self.realtime,
                          engine=self.engine,
-                         max_witnesses=self.max_witnesses)
+                         max_witnesses=self.max_witnesses,
+                         journal=(test or {}).get("_analysis_journal"))
         except IllegalInference as e:
             return {"valid": "unknown", "error": e.info}
         out = {"valid": not r["anomaly-types"], **r}

@@ -81,6 +81,29 @@ def components(full: np.ndarray) -> list:
             if len(c) > 1 or full[c[0], c[0]]]
 
 
+def _job_key(rels, sub: np.ndarray) -> str:
+    """Content identity of one closure job (relation mask + the exact
+    component submatrix), so journaled closures are only reused for
+    bit-identical inputs."""
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(("|".join(rels) + f"#{sub.shape[0]}#").encode())
+    h.update(np.packbits(sub).tobytes())
+    return h.hexdigest()
+
+
+def _pack_closure(m: np.ndarray) -> dict:
+    return {"n": int(m.shape[0]),
+            "bits": np.packbits(m).tobytes().hex()}
+
+
+def _unpack_closure(d) -> np.ndarray:
+    n = int(d["n"])
+    bits = np.frombuffer(bytes.fromhex(d["bits"]), dtype=np.uint8)
+    return np.unpackbits(bits, count=n * n).astype(bool).reshape(n, n)
+
+
 def _closures(mats, engine=None) -> list:
     """Closure of every matrix, through the supervised ladder by
     default or a pinned engine ("host" / "tpu") for parity tooling."""
@@ -127,13 +150,19 @@ def _witness(g: DepGraph, comp, allowed, a, b) -> dict:
 
 
 def classify(g: DepGraph, anomalies=ANOMALIES, *, realtime=False,
-             engine=None, max_witnesses=4) -> dict:
+             engine=None, max_witnesses=4, journal=None) -> dict:
     """Find every requested anomaly in a dependency graph.
 
     Returns {"anomaly-types": [...], "anomalies": {type: [witness]},
     "cycle-count": int, "node-count": int, "component-count": int}.
     Witness lists are capped at max_witnesses per type; the hit COUNT
-    (cycle-count) is exact."""
+    (cycle-count) is exact.
+
+    journal (a store.AnalysisJournal) makes the closure step
+    resumable: each component x mask job is keyed by content hash, a
+    journaled closure is reused (counted in the closure supervisor's
+    journal_skips telemetry) and only the remaining jobs go to the
+    engine; completed closures journal as packed bitmaps."""
     for a in anomalies:
         if a not in _MASKS:
             raise ValueError(f"unknown anomaly {a!r} "
@@ -151,8 +180,30 @@ def classify(g: DepGraph, anomalies=ANOMALIES, *, realtime=False,
     # one supervised batch: |components| x |distinct masks| closures
     keys = list(masks)
     jobs = [(rels, c) for rels in keys for c in comps]
-    closed = _closures([masks[rels][np.ix_(c, c)] for rels, c in jobs],
-                       engine=engine)
+    mats = [masks[rels][np.ix_(c, c)] for rels, c in jobs]
+    closed: list = [None] * len(jobs)
+    jkeys: list = [None] * len(jobs)
+    if journal is not None:
+        for i, ((rels, _), m) in enumerate(zip(jobs, mats)):
+            jkeys[i] = _job_key(rels, m)
+            r = journal.get("closure", jkeys[i])
+            if r is not None:
+                try:
+                    closed[i] = _unpack_closure(r)
+                except (KeyError, TypeError, ValueError):
+                    closed[i] = None
+        skips = sum(1 for x in closed if x is not None)
+        if skips:
+            from .. import supervisor as sup_mod
+
+            sup_mod.get_closure().telemetry.record("journal_skips",
+                                                   skips)
+    todo = [i for i, x in enumerate(closed) if x is None]
+    for i, sub in zip(todo, _closures([mats[i] for i in todo],
+                                      engine=engine)):
+        closed[i] = sub
+        if journal is not None:
+            journal.record("closure", jkeys[i], _pack_closure(sub))
     # reassemble per-mask full-size closure (block-diagonal by
     # construction: no path leaves a weak component)
     closure = {rels: np.zeros((n, n), dtype=bool) for rels in keys}

@@ -71,7 +71,7 @@ LADDER = ("pallas", "tpu", "native", "host")
 COUNTERS = (
     "calls", "retries", "demotions", "breaker_trips", "salvaged_chunks",
     "timeouts", "bisections", "engine_failures", "probe_failures",
-    "exhausted",
+    "exhausted", "journal_skips",
 )
 
 # Threads abandoned by watchdog timeouts: same discipline as the

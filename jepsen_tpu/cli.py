@@ -311,13 +311,58 @@ def _run_analyze(test_fn, opts) -> int:
     return 1 if valid is False or valid is None else 0
 
 
+def _resume_opt_spec(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "run_dir", nargs="?", default=None, metavar="RUN_DIR",
+        help="A store/<name>/<time> run directory to resume "
+        "(default: the latest run in the store).",
+    )
+
+
+def _run_resume(test_fn, opts) -> int:
+    """The `resume` subcommand: reload a preempted run's crash-consistent
+    checkpoint and torn-tail-tolerant WAL, heal every fault left in the
+    active-fault ledger, and continue to the original time budget
+    (core.resume). Exit codes match `test`; a second preemption exits
+    143 with the state saved for another resume."""
+    import os
+
+    from . import core, store
+
+    run_dir = opts.pop("run_dir", None)
+    store_dir = opts.get("store_dir")
+    if run_dir:
+        d = os.path.abspath(run_dir)
+        if not os.path.isdir(d):
+            raise CliError(f"no such run directory: {run_dir}")
+        time_s = os.path.basename(d)
+        name = os.path.basename(os.path.dirname(d))
+        store_dir = store_dir or os.path.dirname(os.path.dirname(d))
+    else:
+        found = store._resolve_latest(store_dir)
+        if found is None:
+            raise RuntimeError("Not sure what the last test was")
+        name, time_s = found
+    test_map = _apply_checker(test_fn(dict(opts)), opts)
+    if test_map.get("name") != name:
+        raise RuntimeError(
+            f"Stored run ({name}) and CLI test ({test_map.get('name')}) "
+            "have different names; aborting")
+    test_map["start_time"] = time_s
+    if store_dir:
+        test_map["store_dir"] = store_dir
+    test = core.resume(test_map)
+    valid = (test.get("results") or {}).get("valid")
+    return 1 if valid is False or valid is None else 0
+
+
 def single_test_cmd(
     test_fn: Callable[[dict], dict],
     opt_spec: Callable[[argparse.ArgumentParser], None] | None = None,
     opt_fn: Callable[[dict], dict] | None = None,
     usage: str | None = None,
 ) -> dict:
-    """`test` + `analyze` subcommands for a test-map constructor
+    """`test` + `analyze` + `resume` subcommands for a test-map constructor
     (cli.clj:323-397). opt_spec adds suite-specific options; opt_fn
     composes after test_opt_fn."""
     fn = (lambda o: opt_fn(test_opt_fn(o))) if opt_fn else test_opt_fn
@@ -336,6 +381,15 @@ def single_test_cmd(
             extra_opts=extra,
             opt_fn=fn,
             usage="Re-analyze the latest stored history with fresh checkers.",
+        ),
+        "resume": Subcommand(
+            run=lambda opts: _run_resume(test_fn, opts),
+            opt_spec=test_opt_spec,
+            extra_opts=extra + [_resume_opt_spec],
+            opt_fn=fn,
+            usage="Resume a preempted run from its checkpoint: heal "
+            "leftover faults, reload the WAL, continue to the original "
+            "time budget.",
         ),
     }
 

@@ -401,6 +401,31 @@ class NemesisWorker(Worker):
 
     def setup(self):
         self.nemesis = self.test["nemesis"].setup(self.test)
+        ledger = self.test.pop("_resume_ledger", None)
+        if ledger:
+            self._heal_ledger(ledger)
+
+    def _heal_ledger(self, ledger) -> None:
+        """Resume contract: every fault the preempted run left planted
+        is healed BEFORE the first generated op — this runs in worker
+        setup(), and do_worker's run latch releases no worker's run()
+        until every setup() finished. Heal ops are journaled like any
+        nemesis op, tagged resume_heal so audits can tell them from
+        scheduled heals."""
+        nem = self.nemesis
+        if hasattr(nem, "restore_faults"):
+            nem.restore_faults(ledger)
+        log.info("Healing %d leftover fault(s) from the preempted run",
+                 len(ledger))
+        for e in ledger:
+            f = e.get("heal_f")
+            if not f:
+                continue
+            op = Op(
+                process=generator.NEMESIS, type="info", f=f, value=None,
+                time=relative_time_nanos(), extra={"resume_heal": True},
+            )
+            self._apply(op)
 
     def run(self):
         test = self.test
@@ -468,15 +493,63 @@ class NemesisWorker(Worker):
             self.nemesis.teardown(self.test)
 
 
+#: default seconds between periodic run-state checkpoints
+CHECKPOINT_INTERVAL = 5.0
+
+
+def checkpoint_state(test) -> dict:
+    """Assemble the crash-consistent run snapshot store.RunCheckpoint
+    persists: generator cursors/rng states, the nemesis active-fault
+    ledger, the process table (next process id per worker thread), the
+    WAL session epoch, and time anchors. Reads live state without
+    locks — a cursor can be at most one draw stale, which resume
+    tolerates (the WAL is the ground truth for landed ops)."""
+    nem = test.get("nemesis")
+    workers = test.get("_client_workers") or []
+    wal = test.get("_wal")
+    return {
+        "v": 1,
+        "generator": generator.snapshot(test["generator"]),
+        "faults": (list(nem.active_faults())
+                   if hasattr(nem, "active_faults") else []),
+        "processes": [w.process for w in workers],
+        "wal_epoch": getattr(wal, "epoch", 0),
+        "wal_count": len(test.get("_history") or ()),
+        "elapsed_nanos": relative_time_nanos(),
+        "wall_clock": _time.time(),
+    }
+
+
+def checkpoint_now(test):
+    """Write a checkpoint immediately; None when the run carries no
+    checkpoint store (no name/start_time)."""
+    ckpt = test.get("_ckpt")
+    if ckpt is None:
+        return None
+    return ckpt.write(checkpoint_state(test))
+
+
+def _checkpoint_loop(test, stop: threading.Event) -> None:
+    interval = test.get("checkpoint_interval") or CHECKPOINT_INTERVAL
+    while not stop.wait(interval):
+        try:
+            checkpoint_now(test)
+        except Exception:  # noqa: BLE001 — checkpointing is best-effort
+            log.warning("periodic checkpoint failed", exc_info=True)
+
+
 def run_case(test) -> list:
     """Spawn nemesis + client workers, run one case, return its history
-    (core.clj:475-504)."""
-    history: list = []
+    (core.clj:475-504). A resumed run pre-seeds the history with the
+    prior sessions' WAL ops and restores each worker's process id."""
+    history: list = list(test.pop("_prior_history", ()))
     lock = threading.Lock()
     test["_history"] = history
     test["_history_lock"] = lock
     test["active_histories"].append((history, lock))
     wal = None
+    ckpt_stop = None
+    ticker = None
     if test.get("name") and test.get("start_time"):
         # durability sidecar: every op lands on disk as it happens, so
         # a SIGKILL'd run leaves a partial history load_history can read
@@ -488,17 +561,48 @@ def run_case(test) -> list:
         except Exception:  # noqa: BLE001 — best-effort durability
             log.warning("couldn't open history WAL", exc_info=True)
             wal = None
+    if wal is not None:
+        try:
+            from . import store
+
+            test["_ckpt"] = store.RunCheckpoint(test)
+            ckpt_stop = threading.Event()
+            ticker = threading.Thread(
+                target=_checkpoint_loop, args=(test, ckpt_stop),
+                daemon=True, name="jepsen checkpoint")
+            ticker.start()
+        except Exception:  # noqa: BLE001 — checkpointing is best-effort
+            log.warning("couldn't open run checkpoint", exc_info=True)
+            ckpt_stop = None
     try:
         nodes = test["nodes"] or [None]
         client_nodes = [
             nodes[i % len(nodes)] for i in range(test["concurrency"])
         ]
-        workers = [NemesisWorker(test)] + [
-            ClientWorker(test, p, node)
-            for p, node in enumerate(client_nodes)
+        procs = test.pop("_resume_processes", None)
+        client_workers = [
+            ClientWorker(
+                test,
+                procs[i] if procs and i < len(procs) else i,
+                node,
+            )
+            for i, node in enumerate(client_nodes)
         ]
+        test["_client_workers"] = client_workers
+        workers = [NemesisWorker(test)] + client_workers
         run_workers(test, workers)
     finally:
+        if ckpt_stop is not None:
+            ckpt_stop.set()
+            ticker.join(timeout=2.0)
+            try:
+                # final checkpoint: post-teardown, so the fault ledger
+                # is empty and cursors sit at the drain point
+                checkpoint_now(test)
+            except Exception:  # noqa: BLE001
+                log.warning("final checkpoint failed", exc_info=True)
+        test.pop("_ckpt", None)
+        test.pop("_client_workers", None)
         test["active_histories"].remove((history, lock))
         if wal is not None:
             test.pop("_wal", None)
@@ -535,10 +639,12 @@ class _SnarfHook:
     installs a JVM shutdown hook so DB logs still download on ctrl-C.
     Python's finally blocks already run on KeyboardInterrupt, but a
     SIGTERM kills the process without unwinding and a crash *during*
-    cleanup can skip the snarf — so while a test runs we (a) convert
-    SIGTERM to SystemExit so finally blocks fire, and (b) register an
-    atexit backstop. snarf-once semantics keep the normal path from
-    downloading twice."""
+    cleanup can skip the snarf — so while a test runs we (a) turn the
+    FIRST SIGTERM into a graceful preemption drain (close the
+    generator gate and let the run wind down, checkpointed and
+    resumable) with a second SIGTERM forcing SystemExit so finally
+    blocks still fire, and (b) register an atexit backstop. snarf-once
+    semantics keep the normal path from downloading twice."""
 
     def __init__(self, test):
         self.test = test
@@ -561,6 +667,19 @@ class _SnarfHook:
         import signal
 
         def on_term(signum, frame):
+            drain = self.test.get("_drain")
+            if drain is not None and not drain.is_set():
+                # graceful preemption drain (TPU maintenance sends
+                # SIGTERM): close the generator gate — workers drain
+                # in-flight invokes through the normal timeout/:info
+                # path, teardown heals active faults, and run_case
+                # flushes the WAL and writes a final checkpoint. A
+                # second SIGTERM forces the old immediate exit.
+                log.warning("SIGTERM: draining run for preemption "
+                            "(send SIGTERM again to force exit)")
+                self.test["_preempted"] = True
+                drain.set()
+                return
             raise SystemExit(143)
 
         atexit.register(self.snarf_once)
@@ -586,7 +705,11 @@ class _SnarfHook:
 
 def analyze(test) -> dict:
     """Index the history, run the checker, persist results
-    (core.clj:506-523)."""
+    (core.clj:506-523). With a store attached, completed analysis units
+    journal to analysis.ckpt.jsonl (store.AnalysisJournal) as they
+    finish — the independent checker's per-key verdicts and the cycle
+    checker's per-component closures — so re-running analysis of a huge
+    history skips finished work instead of restarting."""
     log.info("Analyzing...")
     hist = test["history"]
     # run() pre-indexes before save_1; skip the second full re-allocation
@@ -594,9 +717,24 @@ def analyze(test) -> dict:
     # histories may still need it).
     if any(o.index != i for i, o in enumerate(hist)):
         test["history"] = index(hist)
-    test["results"] = checker_mod.check_safe(
-        test["checker"], test, test["history"], {}
-    )
+    journal = None
+    if test.get("name") and test.get("start_time"):
+        try:
+            from . import store
+
+            journal = store.AnalysisJournal(test)
+            test["_analysis_journal"] = journal
+        except Exception:  # noqa: BLE001 — journaling is best-effort
+            log.warning("couldn't open analysis journal", exc_info=True)
+            journal = None
+    try:
+        test["results"] = checker_mod.check_safe(
+            test["checker"], test, test["history"], {}
+        )
+    finally:
+        if journal is not None:
+            test.pop("_analysis_journal", None)
+            journal.close()
     log.info("Analysis complete")
     if test.get("name") and test.get("start_time"):
         try:
@@ -639,7 +777,11 @@ def prepare(test: dict) -> dict:
     test.setdefault("start_time", datetime.datetime.now())
     test["active_histories"] = []
     test["remote"] = control.remote_for_test(test)
-    test["generator"] = with_recovery_phases(test)
+    # drain gate outermost: a SIGTERM stops generation for EVERY phase,
+    # and run/resume snapshot/restore the same generator shape
+    test["_drain"] = threading.Event()
+    test["generator"] = generator.interruptible(
+        with_recovery_phases(test), test["_drain"])
     return test
 
 
@@ -671,13 +813,23 @@ def run(test: dict) -> dict:
                     try:
                         with with_relative_time():
                             test["history"] = index(run_case(test))
+                        preempted = test.pop("_preempted", False)
                         log.info("Run complete, writing")
                         if store is not None and test.get("name"):
                             store.save_1(test)
+                        if preempted:
+                            # leave the cluster as-is: resuming needs
+                            # the DB's on-node state
+                            test["_preserve_db"] = True
+                            log.warning(
+                                "Run preempted; checkpoint + WAL saved "
+                                "— continue with `jepsen-tpu resume`")
+                            raise SystemExit(143)
                         analyze(test)
                     finally:
                         hook.snarf_once()
-                        if test.get("db") is not None:
+                        if (test.get("db") is not None
+                                and not test.get("_preserve_db")):
                             control.on_nodes(
                                 test,
                                 lambda t, n: test["db"].teardown(t, n),
@@ -693,6 +845,79 @@ def run(test: dict) -> dict:
     finally:
         if store is not None:
             store.stop_logging(test)
+
+
+def resume(test: dict) -> dict:
+    """Resume a preempted or SIGKILL'd run from its crash-consistent
+    checkpoint (the `jepsen-tpu resume` path). The test dict must carry
+    the ORIGINAL run's name and start_time (the CLI resolves them from
+    the run dir) plus the same seed/options, so prepare() rebuilds a
+    structurally identical generator for restore().
+
+    Sequence: salvage the torn-tail-tolerant WAL as the prior history
+    (the reopened WAL appends under session epoch last+1, so op indices
+    never collide), restore generator/nemesis cursors from the
+    checkpoint, heal every fault in the active-fault ledger BEFORE the
+    first generated op (NemesisWorker setup), and continue to the
+    original time budget. The cluster is NOT re-provisioned — no OS
+    setup, no DB cycle — because preserved node state is the point of
+    resuming. At-least-once caveat: cursors can trail the WAL by the
+    one draw in flight at the kill, so a resumed schedule may re-emit
+    that op."""
+    from . import store
+
+    assert test.get("name") and test.get("start_time"), (
+        "resume needs the original run's name and start_time")
+    test = prepare(test)
+    ckpt = store.load_checkpoint(test)
+    if ckpt is None:
+        raise FileNotFoundError(
+            f"no usable run checkpoint under {store.path(test)}")
+    test["_prior_history"] = store.load_wal_history(test)
+    gen_state = ckpt.get("generator")
+    if gen_state:
+        generator.restore(test["generator"], gen_state)
+    ledger = list(ckpt.get("faults") or [])
+    if ledger:
+        test["_resume_ledger"] = ledger
+    procs = ckpt.get("processes")
+    if procs:
+        test["_resume_processes"] = [int(p) for p in procs]
+    log.info(
+        "Resuming run %s/%s: %d prior op(s), %d leftover fault(s)",
+        test["name"], store.time_str(test["start_time"]),
+        len(test["_prior_history"]), len(ledger))
+    store.start_logging(test)
+    try:
+        real_pmap(test["remote"].connect, test["nodes"])
+        try:
+            with _SnarfHook(test) as hook:
+                try:
+                    with with_relative_time(
+                            int(ckpt.get("elapsed_nanos") or 0)):
+                        test["history"] = index(run_case(test))
+                    preempted = test.pop("_preempted", False)
+                    log.info("Resumed run complete, writing")
+                    store.save_1(test)
+                    if preempted:
+                        test["_preserve_db"] = True
+                        log.warning("Resumed run preempted again; "
+                                    "state saved for another resume")
+                        raise SystemExit(143)
+                    analyze(test)
+                finally:
+                    hook.snarf_once()
+                    if (test.get("db") is not None
+                            and not test.get("_preserve_db")):
+                        control.on_nodes(
+                            test, lambda t, n: test["db"].teardown(t, n))
+        finally:
+            for node in test["nodes"]:
+                test["remote"].disconnect(node)
+        log_results(test)
+        return test
+    finally:
+        store.stop_logging(test)
 
 
 def log_results(test) -> dict:

@@ -325,6 +325,10 @@ class SeqGen(Generator):
         self._it = iter(coll)
         self._lock = threading.Lock()
         self._done = False
+        # draws so far — snapshot/restore replays this many next() calls
+        # on a freshly-built identical iterator (drawing must therefore
+        # be side-effect-free: elements act only when op() is called)
+        self._n = 0
 
     def op(self, test, process):
         while True:
@@ -333,6 +337,7 @@ class SeqGen(Generator):
                     return None
                 try:
                     gen = next(self._it)
+                    self._n += 1
                 except StopIteration:
                     self._done = True
                     return None
@@ -743,3 +748,161 @@ class SingleThreaded(Generator):
 
 def singlethreaded(gen) -> SingleThreaded:
     return SingleThreaded(gen)
+
+
+# ---------------------------------------------------------------------------
+# Preemption: drain gate + checkpoint snapshot/restore
+
+class Interruptible(Generator):
+    """A drain gate: delegates until `event` is set, then yields None
+    forever. core.prepare wraps the top-level generator in one so a
+    SIGTERM (the TPU maintenance signal) can stop generation without
+    touching workers — every thread sees exhaustion on its next draw
+    and in-flight invokes drain through the normal timeout/:info path.
+    Stateless, so it's a transparent node in checkpoint snapshots."""
+
+    def __init__(self, gen, event: threading.Event):
+        self.gen = to_gen(gen)
+        self.event = event
+
+    def op(self, test, process):
+        if self.event.is_set():
+            return None
+        return self.gen.op(test, process)
+
+
+def interruptible(gen, event: threading.Event) -> Interruptible:
+    return Interruptible(gen, event)
+
+
+def _children(g) -> list:
+    """The sub-generators a combinator owns, in a fixed order (the
+    snapshot/restore traversal spine)."""
+    if isinstance(g, Mix):
+        return list(g.gens)
+    if isinstance(g, Concat):
+        return list(g.sources)
+    if isinstance(g, Reserve):
+        return [gen for _, _, gen in g.ranges] + [g.default]
+    sub = getattr(g, "gen", None)
+    return [sub] if isinstance(sub, Generator) else []
+
+
+def _state_of(g):
+    """The JSON-serializable cursor state of one node, or None for
+    stateless nodes (and unknown subclasses, which snapshot opaque)."""
+    if isinstance(g, Once):
+        return {"emitted": g._emitted}
+    if isinstance(g, Limit):
+        return {"remaining": g._remaining}
+    if isinstance(g, TimeLimit):
+        if g._deadline is None:
+            return {"remaining": None}
+        return {"remaining": max(0.0, g._deadline - _time.monotonic())}
+    if isinstance(g, SeqGen):
+        return {"n": g._n, "done": g._done}
+    if isinstance(g, Concat):
+        return {"index": [[p, i] for p, i in sorted(
+            g._index.items(), key=lambda kv: str(kv[0]))]}
+    if isinstance(g, Synchronize):
+        return {"cleared": g._cleared}
+    if isinstance(g, Mix):
+        if isinstance(g.rng, random.Random):
+            version, state, gauss = g.rng.getstate()
+            return {"rng": [version, list(state), gauss]}
+        return None
+    if isinstance(g, QueueGen):
+        return {"i": g._i}
+    if isinstance(g, DrainQueue):
+        return {"outstanding": g._outstanding}
+    if isinstance(g, Await):
+        return {"state": g._state}
+    return None
+
+
+def snapshot(gen) -> dict:
+    """A JSON-serializable snapshot of a generator tree's cursors and
+    rng states, for store.RunCheckpoint. Reads plain attributes under
+    the GIL without taking generator locks, so it's safe from the
+    checkpoint thread while workers run — a cursor may be at most one
+    draw stale, and resume tolerates that: the WAL is the ground truth
+    for which ops actually landed (at-least-once re-emission of the
+    final in-flight draw is the documented contract).
+
+    Unknown Generator subclasses become opaque leaves (type name only,
+    no children): their state is not captured, and deterministic resume
+    requires the schedule to be built from snapshot-aware combinators.
+    Mix rng state is captured only for a private random.Random (the
+    seeded-package case); the global `random` module is skipped."""
+    g = to_gen(gen)
+    node: dict = {"t": type(g).__name__}
+    s = _state_of(g)
+    if s is not None:
+        node["s"] = s
+    kids = _children(g)
+    if kids:
+        node["k"] = [snapshot(c) for c in kids]
+    return node
+
+
+def _restore_state(g, s) -> None:
+    if s is None:
+        return
+    if isinstance(g, Once):
+        g._emitted = bool(s["emitted"])
+    elif isinstance(g, Limit):
+        g._remaining = s["remaining"]
+    elif isinstance(g, TimeLimit):
+        rem = s.get("remaining")
+        # remaining budget, not a fresh window: the run continues to
+        # its ORIGINAL time limit
+        g._deadline = None if rem is None else _time.monotonic() + rem
+    elif isinstance(g, SeqGen):
+        n = int(s.get("n", 0))
+        for _ in range(n):
+            try:
+                next(g._it)
+            except StopIteration:
+                g._done = True
+                break
+        g._n = n
+        g._done = g._done or bool(s.get("done"))
+    elif isinstance(g, Concat):
+        g._index = {p: i for p, i in s.get("index", [])}
+    elif isinstance(g, Synchronize):
+        g._cleared = bool(s["cleared"])
+    elif isinstance(g, Mix):
+        rng_s = s.get("rng")
+        if rng_s is not None and isinstance(g.rng, random.Random):
+            version, state, gauss = rng_s
+            g.rng.setstate((version, tuple(state), gauss))
+    elif isinstance(g, QueueGen):
+        g._i = int(s["i"])
+    elif isinstance(g, DrainQueue):
+        g._outstanding = int(s["outstanding"])
+    elif isinstance(g, Await):
+        g._state = s["state"]
+
+
+def restore(gen, node: dict) -> None:
+    """Restore cursors saved by snapshot() into a structurally
+    identical, freshly-rebuilt generator tree (same combinators in the
+    same shape — i.e. reconstructed from the same seed/opts). SeqGen
+    replays its draw count against the fresh iterator; TimeLimit gets
+    its REMAINING budget, preserving the original deadline. Raises
+    ValueError on any shape/type mismatch rather than silently
+    resuming a different schedule."""
+    g = to_gen(gen)
+    if node.get("t") != type(g).__name__:
+        raise ValueError(
+            f"checkpoint shape mismatch: saved {node.get('t')!r}, "
+            f"rebuilt {type(g).__name__!r}")
+    _restore_state(g, node.get("s"))
+    kids = _children(g)
+    saved = node.get("k") or []
+    if len(kids) != len(saved):
+        raise ValueError(
+            f"checkpoint shape mismatch under {node['t']}: "
+            f"{len(saved)} saved children vs {len(kids)} rebuilt")
+    for c, n in zip(kids, saved):
+        restore(c, n)

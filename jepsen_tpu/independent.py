@@ -235,6 +235,35 @@ class IndependentChecker(Checker):
         history = list(history)
         ks = sorted(history_keys(history), key=str)
 
+        # Resumable analysis: with an AnalysisJournal attached
+        # (core.analyze), per-key verdicts journaled by a previous —
+        # possibly killed — analysis pass are reused and their keys
+        # skipped entirely. Journal identity covers the subhistory
+        # CONTENT, not just the key, so a key whose history grew (a
+        # resumed run) re-checks instead of reusing a stale verdict.
+        journal = (test or {}).get("_analysis_journal")
+        journaled: dict = {}
+        jkeys: dict = {}
+        if journal is not None:
+            remaining = []
+            for k in ks:
+                jk = _journal_key(k, subhistory(k, history))
+                jkeys[k] = jk
+                r = journal.get("independent-key", jk)
+                if r is not None:
+                    journaled[k] = r
+                else:
+                    remaining.append(k)
+            if journaled:
+                from .checker import supervisor as sup_mod
+
+                sup_mod.get().telemetry.record(
+                    "journal_skips", len(journaled))
+                logging.getLogger("jepsen_tpu.independent").info(
+                    "analysis journal: skipping %d finished key(s), "
+                    "%d to check", len(journaled), len(remaining))
+            ks = remaining
+
         def check_key(k):
             sub = subhistory(k, history)
             subdir = list(opts.get("subdirectory") or []) + [DIR, str(k)]
@@ -307,6 +336,10 @@ class IndependentChecker(Checker):
                 results[k] = r
         elif results is None:
             results = dict(bounded_pmap(check_key, ks))
+        if journal is not None:
+            for k, r in results.items():
+                journal.record("independent-key", jkeys[k], r)
+            results = {**journaled, **results}
         # Only definite falsifications are failures; "unknown" keys are
         # excluded, as in the reference (independent.clj:283-291, where
         # :unknown is truthy)
@@ -341,6 +374,19 @@ class IndependentChecker(Checker):
                 store.write_history_txt(test, subdir + ["history.txt"], sub)
         except Exception:  # noqa: BLE001 - artifact writing is best-effort
             pass
+
+
+def _journal_key(k, sub) -> str:
+    """A stable journal identity for one key's analysis: the key plus
+    a digest of its subhistory's verdict-relevant fields. Anything that
+    changes the check's input changes the identity."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for o in sub:
+        h.update(repr((o.process, o.type, o.f, o.value,
+                       o.index, o.error)).encode())
+    return f"{k}#{len(sub)}#{h.hexdigest()[:16]}"
 
 
 def _picklable_map(m: dict) -> dict:

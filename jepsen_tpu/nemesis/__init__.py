@@ -20,7 +20,10 @@ log = logging.getLogger("jepsen_tpu.nemesis")
 
 
 class Nemesis:
-    """Lifecycle mirror of nemesis.clj:9-14."""
+    """Lifecycle mirror of nemesis.clj:9-14, plus the active-fault
+    ledger hooks preemption-tolerant runs checkpoint: a preempted run
+    leaves partitions/tc rules/SIGSTOPs planted on nodes, and resume
+    must heal them before generating a single op."""
 
     def setup(self, test) -> "Nemesis":
         return self
@@ -30,6 +33,18 @@ class Nemesis:
 
     def teardown(self, test) -> None:
         pass
+
+    def active_faults(self) -> list[dict]:
+        """The ledger of faults currently planted: one dict per fault,
+        carrying at least {"kind", "heal_f"} (heal_f is the op :f that
+        revokes it) plus whatever state restore_faults needs. Stateless
+        nemeses report none."""
+        return []
+
+    def restore_faults(self, entries: list[dict]) -> None:
+        """Rehydrate internal fault state from a checkpointed ledger
+        (the resumed process starts with fresh objects), so the heal
+        ops the resume path fires actually know their targets."""
 
 
 class Noop(Nemesis):
@@ -114,6 +129,7 @@ class Partitioner(Nemesis):
 
     def __init__(self, grudge_fn: Callable[[list], Mapping]):
         self.grudge_fn = grudge_fn
+        self._grudge: dict | None = None
 
     def setup(self, test):
         test["net"].heal(test)
@@ -127,16 +143,29 @@ class Partitioner(Nemesis):
                 else self.grudge_fn(list(test["nodes"]))
             )
             test["net"].drop_all(test, grudge)
+            self._grudge = {n: sorted(v) for n, v in grudge.items()}
             return op.with_(
                 type="info", value=f"Cut off {_render_grudge(grudge)}"
             )
         if op.f == "stop":
             test["net"].heal(test)
+            self._grudge = None
             return op.with_(type="info", value="fully connected")
         raise ValueError(f"partitioner can't handle op {op.f!r}")
 
     def teardown(self, test):
         test["net"].heal(test)
+        self._grudge = None
+
+    def active_faults(self):
+        if self._grudge is None:
+            return []
+        return [{"kind": "partition", "heal_f": "stop",
+                 "grudge": self._grudge}]
+
+    def restore_faults(self, entries):
+        for e in entries:
+            self._grudge = dict(e.get("grudge") or {})
 
 
 def _render_grudge(grudge: Mapping) -> dict:
@@ -211,6 +240,33 @@ class Compose(Nemesis):
         for nem in self.nemeses.values():
             nem.teardown(test)
 
+    def active_faults(self):
+        """Children's ledgers, with each inner heal_f translated back
+        to the OUTER op name this Compose routes (rename-map keys), so
+        the resume path can fire heal ops straight at the top."""
+        out = []
+        for fs, nem in self.nemeses.items():
+            for e in nem.active_faults():
+                e = dict(e)
+                f = e.get("heal_f")
+                if isinstance(fs, Mapping):
+                    for outer, inner in fs.items():
+                        if inner == f:
+                            e["heal_f"] = outer
+                            break
+                out.append(e)
+        return out
+
+    def restore_faults(self, entries):
+        for e in entries:
+            try:
+                nem, inner_f = self._route(e.get("heal_f"))
+            except ValueError:
+                log.warning("no nemesis routes ledger entry %r; dropping",
+                            e)
+                continue
+            nem.restore_faults([{**e, "heal_f": inner_f}])
+
 
 def compose(nemeses: Mapping) -> Compose:
     return Compose(nemeses)
@@ -232,6 +288,7 @@ class ClockScrambler(Nemesis):
         self.dt = dt
         self.rng = rng or _random
         self.set_time_fn = set_time_fn
+        self._scrambled = False
 
     def _set(self, test, node, t):
         if self.set_time_fn is not None:
@@ -247,6 +304,7 @@ class ClockScrambler(Nemesis):
         if op.f in ("reset", "stop"):
             on_nodes(test,
                      lambda t, node: self._set(test, node, _time.time()))
+            self._scrambled = False
             return op.with_(type="info", value="clocks reset")
 
         dt = self.dt
@@ -261,6 +319,7 @@ class ClockScrambler(Nemesis):
             # float dt (the reference's rand-int coerces doubles)
             self._set(test, node, _time.time() + offsets[node])
 
+        self._scrambled = True
         return op.with_(value=on_nodes(test, scramble))
 
     def teardown(self, test):
@@ -269,6 +328,16 @@ class ClockScrambler(Nemesis):
         from ..control import on_nodes
 
         on_nodes(test, lambda t, node: self._set(test, node, _time.time()))
+        self._scrambled = False
+
+    def active_faults(self):
+        if not self._scrambled:
+            return []
+        return [{"kind": "clock", "heal_f": "reset"}]
+
+    def restore_faults(self, entries):
+        if entries:
+            self._scrambled = True
 
 
 def clock_scrambler(dt: float, rng=None, set_time_fn=None) -> ClockScrambler:
@@ -323,6 +392,16 @@ class NodeStartStopper(Nemesis):
             except Exception:  # noqa: BLE001 — teardown is best-effort
                 log.warning("couldn't revive %s during teardown", n,
                             exc_info=True)
+
+    def active_faults(self):
+        if not self.affected:
+            return []
+        return [{"kind": "start-stop", "heal_f": "stop",
+                 "nodes": list(self.affected)}]
+
+    def restore_faults(self, entries):
+        for e in entries:
+            self.affected = list(e.get("nodes") or [])
 
 
 def node_start_stopper(targeter, stop_fn, start_fn) -> NodeStartStopper:

@@ -290,6 +290,19 @@ class ProcessNemesis(Nemesis):
                 log.warning("couldn't revive %s during teardown", node,
                             exc_info=True)
 
+    def active_faults(self):
+        with self._lock:
+            targets = sorted(self.affected)
+        if not targets:
+            return []
+        return [{"kind": f"process-{self.mode}", "heal_f": self.heal_f,
+                 "nodes": targets}]
+
+    def restore_faults(self, entries):
+        with self._lock:
+            for e in entries:
+                self.affected.update(e.get("nodes") or [])
+
 
 def _process_package(opts: dict, mode: str, proto,
                      color: str) -> NemesisPackage:
@@ -415,23 +428,39 @@ class PacketNemesis(Nemesis):
 
     BEHAVIORS = ("slow", "flaky")
 
+    def __init__(self):
+        self._behavior = None
+
     def invoke(self, test, op):
         net = test["net"]
         if op.f == "packet-start":
             behavior = op.value or "slow"
             assert behavior in self.BEHAVIORS, behavior
             getattr(net, behavior)(test)
+            self._behavior = behavior
             return op.with_(type="info", value=behavior)
         if op.f == "packet-stop":
             net.fast(test)
+            self._behavior = None
             return op.with_(type="info", value="fast")
         raise ValueError(f"packet nemesis can't handle {op.f!r}")
 
     def teardown(self, test):
+        self._behavior = None
         try:
             test["net"].fast(test)
         except Exception:  # noqa: BLE001 — teardown is best-effort
             log.warning("couldn't restore network speed", exc_info=True)
+
+    def active_faults(self):
+        if self._behavior is None:
+            return []
+        return [{"kind": "packet", "heal_f": "packet-stop",
+                 "behavior": self._behavior}]
+
+    def restore_faults(self, entries):
+        for e in entries:
+            self._behavior = e.get("behavior") or "slow"
 
 
 def packet_package(opts: dict) -> NemesisPackage:

@@ -169,6 +169,26 @@ write_edn = write_json
 #: land during the run (vs history.jsonl, written once at save_1)
 WAL_FILE = "history.wal.jsonl"
 
+#: when HistoryWAL calls os.fsync: every op / nemesis ops + close / close
+WAL_FSYNC_POLICIES = ("op", "nemesis", "close")
+
+
+def _terminate_torn_tail(f, p: str) -> None:
+    """A mid-write kill can leave an append-mode JSONL file without a
+    trailing newline; the next append would glue onto the torn line and
+    corrupt BOTH records. Terminate the tail so the torn line stays an
+    isolated, droppable parse failure."""
+    try:
+        size = os.path.getsize(p)
+        if size:
+            with open(p, "rb") as r:
+                r.seek(size - 1)
+                if r.read(1) != b"\n":
+                    f.write("\n")
+                    f.flush()
+    except OSError:
+        pass
+
 
 class HistoryWAL:
     """Append-only JSONL write-ahead log of the live history.
@@ -180,26 +200,226 @@ class HistoryWAL:
     final ``store.write_history`` is otherwise all-or-nothing. A torn
     final line (killed mid-write) is expected and tolerated on load.
 
+    Every line is stamped with a **session epoch** (``_epoch``): a
+    resumed run reopens the same file in append mode under epoch
+    last+1, so ``load_history`` can reindex deterministically across
+    sessions instead of colliding op indices. The stamp is an engine
+    key, stripped before ops are rebuilt.
+
+    The fsync policy is configurable (``test["wal_fsync"]`` or the
+    ``fsync`` argument): ``"op"`` fsyncs every line (maximum
+    durability, slowest), ``"nemesis"`` (the default) fsyncs lines the
+    nemesis lands — fault boundaries are always durable without paying
+    per-op fsync — and ``"close"`` only on close. Every policy still
+    flushes each line to the OS, so only an OS/power crash (not a mere
+    process SIGKILL) can lose un-fsynced ops.
+
     Appends are serialized by a lock: client workers and the nemesis
     land ops concurrently. A failed append disables the WAL rather than
     failing the run — durability is best-effort, the verdict is not."""
 
-    def __init__(self, test):
+    def __init__(self, test, fsync: str | None = None):
+        policy = fsync or (test or {}).get("wal_fsync") or "nemesis"
+        if policy not in WAL_FSYNC_POLICIES:
+            raise ValueError(
+                f"wal_fsync must be one of {WAL_FSYNC_POLICIES}, "
+                f"got {policy!r}")
+        self.fsync_policy = policy
         self._path = path_(test, WAL_FILE)
         self._lock = threading.Lock()
+        self.epoch = self._next_epoch(self._path)
         self._f = open(self._path, "a")
+        _terminate_torn_tail(self._f, self._path)
+
+    @staticmethod
+    def _next_epoch(p: str) -> int:
+        """One past the last parseable line's epoch; 0 for a fresh file.
+        A nonempty file with no parseable line still advances (a prior
+        session existed, even if only its torn tail survives)."""
+        try:
+            if not os.path.exists(p) or os.path.getsize(p) == 0:
+                return 0
+        except OSError:
+            return 0
+        last = None
+        with open(p) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    last = json.loads(line)
+                except ValueError:
+                    continue
+        if not isinstance(last, dict):
+            return 1
+        try:
+            return int(last.get("_epoch", 0)) + 1
+        except (TypeError, ValueError):
+            return 1
 
     def append(self, op: Op) -> None:
         with self._lock:
             if self._f is None:
                 return
             try:
-                self._f.write(json.dumps(op.to_dict(),
-                                         default=_json_default))
+                rec = op.to_dict()
+                rec["_epoch"] = self.epoch
+                self._f.write(json.dumps(rec, default=_json_default))
                 self._f.write("\n")
                 self._f.flush()
+                if self.fsync_policy == "op" or (
+                    self.fsync_policy == "nemesis"
+                    and op.process == "nemesis"
+                ):
+                    os.fsync(self._f.fileno())
             except Exception:  # noqa: BLE001 — best-effort durability
                 log.warning("history WAL append failed; disabling",
+                            exc_info=True)
+                try:
+                    self._f.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._f = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                except (OSError, ValueError):
+                    pass
+                self._f.close()
+                self._f = None
+
+
+#: crash-consistent snapshot of live run state, written periodically
+CKPT_FILE = "run.ckpt.json"
+
+
+class RunCheckpoint:
+    """Crash-consistent run-state snapshots for preemption-tolerant
+    runs: generator cursors/rng states, the nemesis active-fault
+    ledger, the process table, the WAL session epoch, and a wall-clock
+    anchor (core.checkpoint_state assembles the dict; this class only
+    guarantees durability).
+
+    write() goes temp → flush+fsync → rotate current→``.prev`` →
+    rename temp→current, so a SIGKILL at ANY instant leaves the new
+    checkpoint, the previous good one, or both — never zero. load()
+    validates the current file and falls back to ``.prev`` on a
+    torn/truncated/missing current; a stale ``.tmp`` leftover is
+    ignored and overwritten by the next write."""
+
+    def __init__(self, test):
+        self._path = path_(test, CKPT_FILE)
+        self._lock = threading.Lock()
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def write(self, state: dict) -> str:
+        tmp = self._path + ".tmp"
+        with self._lock:
+            with open(tmp, "w") as f:
+                json.dump(_json_keys(state), f, default=_json_default)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(self._path):
+                os.replace(self._path, self._path + ".prev")
+            os.replace(tmp, self._path)
+        return self._path
+
+    def load(self) -> dict | None:
+        """The newest readable checkpoint, or None when neither the
+        current file nor .prev parses."""
+        for p in (self._path, self._path + ".prev"):
+            try:
+                with open(p) as f:
+                    state = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(state, dict):
+                return state
+        return None
+
+
+def load_checkpoint(test) -> dict | None:
+    """The newest readable run checkpoint for a test dir, or None."""
+    return RunCheckpoint(test).load()
+
+
+#: append-only journal of finished analysis units (resumable analysis)
+ANALYSIS_CKPT_FILE = "analysis.ckpt.jsonl"
+
+
+class AnalysisJournal:
+    """Append-only JSONL journal of completed analysis verdicts, so
+    re-running analysis of a huge history skips finished work: the
+    independent checker journals per-key linearizability verdicts
+    ("independent-key") and the cycle checker journals per-component
+    closure results ("closure") as they complete.
+
+    Each line is ``{"kind", "key", "result"}``; keys are stringified
+    for a stable JSON identity. Loading tolerates a torn tail (a kill
+    mid-append loses at most the line being written). Journaled results
+    round-trip through JSON — Ops inside come back as plain dicts — so
+    consumers treat them as opaque verdicts, not live objects."""
+
+    def __init__(self, test):
+        self._path = path_(test, ANALYSIS_CKPT_FILE)
+        self._lock = threading.Lock()
+        self._done: dict = {}
+        try:
+            with open(self._path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                        self._done[(rec["kind"], rec["key"])] = \
+                            rec.get("result")
+                    except (ValueError, KeyError, TypeError):
+                        log.warning(
+                            "analysis journal: dropping torn line %r",
+                            line[:80])
+        except FileNotFoundError:
+            pass
+        self._f = open(self._path, "a")
+        _terminate_torn_tail(self._f, self._path)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def __len__(self) -> int:
+        return len(self._done)
+
+    def contains(self, kind: str, key) -> bool:
+        return (kind, str(key)) in self._done
+
+    def get(self, kind: str, key):
+        return self._done.get((kind, str(key)))
+
+    def record(self, kind: str, key, result) -> None:
+        key = str(key)
+        with self._lock:
+            if (kind, key) in self._done:
+                return
+            self._done[(kind, key)] = result
+            if self._f is None:
+                return
+            try:
+                self._f.write(json.dumps(
+                    {"kind": kind, "key": key,
+                     "result": _json_keys(result)},
+                    default=_json_default))
+                self._f.write("\n")
+                self._f.flush()
+            except Exception:  # noqa: BLE001 — journal is best-effort
+                log.warning("analysis journal append failed; disabling",
                             exc_info=True)
                 try:
                     self._f.close()
@@ -351,22 +571,45 @@ def load_history(test) -> list[Op]:
         return TensorHistory.load(p).decode()
     p = path(test, WAL_FILE)
     if os.path.exists(p):
-        out = []
-        with open(p) as f:
-            for line in f:
-                if not line.strip():
-                    continue
-                try:
-                    out.append(Op.from_dict(json.loads(line)))
-                except (ValueError, KeyError):
-                    # torn tail from a mid-write kill: salvage the prefix
-                    log.warning("WAL: dropping unparseable line %r",
-                                line[:80])
-        # WAL lines land BEFORE history finalization assigns indices
-        # (index=-1); reindex in arrival order so the salvaged history
-        # is analyzable (pairs/checkers require monotonic indices)
-        return [o.with_(index=i) for i, o in enumerate(out)]
+        return load_wal_history(test)
     raise FileNotFoundError(f"no stored history under {path(test)}")
+
+
+def _parse_wal(p: str) -> list[tuple[int, Op]]:
+    """(epoch, op) pairs from a WAL file, tolerating a torn tail and
+    stripping the "_"-prefixed engine stamps before ops are rebuilt
+    (Op.from_dict would otherwise shelve them under .extra)."""
+    out = []
+    with open(p) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                epoch = int(rec.pop("_epoch", 0))
+                for k in [k for k in rec
+                          if isinstance(k, str) and k.startswith("_")]:
+                    del rec[k]
+                out.append((epoch, Op.from_dict(rec)))
+            except (ValueError, KeyError, TypeError, AttributeError):
+                # torn tail from a mid-write kill: salvage the prefix
+                log.warning("WAL: dropping unparseable line %r", line[:80])
+    return out
+
+
+def load_wal_history(test) -> list[Op]:
+    """The salvageable ops of a run's WAL, reindexed 0..n-1. Lines are
+    stable-sorted by session epoch first (arrival order preserved
+    within an epoch), so a run appended across resume sessions gets
+    monotonic, collision-free indices — WAL lines land BEFORE history
+    finalization assigns indices (index=-1), and pairs/checkers require
+    monotonic ones. Returns [] when no WAL exists."""
+    p = path(test, WAL_FILE)
+    if not os.path.exists(p):
+        return []
+    pairs = _parse_wal(p)
+    pairs.sort(key=lambda pair: pair[0])
+    return [o.with_(index=i) for i, (_, o) in enumerate(pairs)]
 
 
 def load(name, time_s, store_dir=None) -> dict:

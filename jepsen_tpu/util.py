@@ -215,10 +215,13 @@ def relative_time_nanos() -> int:
 
 
 @contextlib.contextmanager
-def with_relative_time():
-    """Scope with a fresh relative-time origin (util.clj: with-relative-time)."""
+def with_relative_time(elapsed_nanos: int = 0):
+    """Scope with a fresh relative-time origin (util.clj:
+    with-relative-time). elapsed_nanos backdates the origin — a
+    resumed run passes the preempted session's elapsed time so op
+    timestamps stay monotone across sessions."""
     prev = _relative_origin
-    init_relative_time()
+    init_relative_time(_time.monotonic_ns() - int(elapsed_nanos))
     try:
         yield
     finally:

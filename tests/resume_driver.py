@@ -1,0 +1,214 @@
+"""Deterministic run driver for the SIGKILL → resume chaos e2e
+(tests/test_resume_e2e.py). Importable, and runnable as a subprocess:
+
+    python -m tests.resume_driver killable <scratch-dir>
+
+Every source of randomness is pregenerated: client ops are an explicit
+op list, fault targets are literal node lists, and the kill-trigger
+phase emits no op in any mode — so an uninterrupted run and a
+killed-then-resumed run draw identical client/nemesis schedules and
+their verdicts must match bit for bit.
+
+Phase layout (barrier-synchronized by gen.phases):
+
+  1. faults + main client ops   kill n2, pause n3; client CAS workload
+  2. kill trigger (nemesis)     with JEPSEN_TPU_RESUME_KILL set, write
+                                a checkpoint and SIGKILL ourselves —
+                                faults still active, clients parked at
+                                the phase-3 barrier (no in-flight ops)
+  3. scheduled heals            restart + resume
+  4. stability client ops       post-heal traffic for the recovery
+                                checker
+
+The register is file-backed so its state survives the SIGKILL the way
+a real cluster's state survives a control-plane preemption."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import core, db as db_mod, generator as gen
+from jepsen_tpu import models, nemesis as nem_mod, net as net_mod, osenv
+from jepsen_tpu.checker.recovery import RecoveryChecker
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.nemesis import combined as comb
+
+KILL_ENV = "JEPSEN_TPU_RESUME_KILL"
+START_TIME = "20260805T000000.000"
+NODES = ["n1", "n2", "n3"]
+
+MAIN_OPS = [
+    {"f": "write", "value": 1},
+    {"f": "read", "value": None},
+    {"f": "cas", "value": [1, 2]},
+    {"f": "read", "value": None},
+    {"f": "write", "value": 3},
+    {"f": "cas", "value": [9, 9]},  # doomed cas: exercises :fail
+    {"f": "read", "value": None},
+]
+FAULT_OPS = [
+    {"type": "info", "f": "kill", "value": ["n2"]},
+    {"type": "info", "f": "pause", "value": ["n3"]},
+]
+HEAL_OPS = [
+    {"type": "info", "f": "restart", "value": None},
+    {"type": "info", "f": "resume", "value": None},
+]
+STABILITY_OPS = [
+    {"f": "write", "value": 10},
+    {"f": "read", "value": None},
+    {"f": "cas", "value": [10, 11]},
+    {"f": "read", "value": None},
+]
+FAMILIES = {
+    "kill": {"faults": {"kill"}, "heals": {"restart"}},
+    "pause": {"faults": {"pause"}, "heals": {"resume"}},
+}
+
+
+class RecordingProcDB(db_mod.DB, db_mod.Kill, db_mod.Pause):
+    """Process-protocol stub: records calls, never impedes clients —
+    faults are bookkeeping the ledger must carry, not real outages."""
+
+    def __init__(self):
+        self.calls = []
+
+    def setup(self, test, node): ...
+    def teardown(self, test, node): ...
+
+    def kill(self, test, node):
+        self.calls.append(("kill", node))
+
+    def start(self, test, node):
+        self.calls.append(("start", node))
+
+    def pause(self, test, node):
+        self.calls.append(("pause", node))
+
+    def resume(self, test, node):
+        self.calls.append(("resume", node))
+
+    def alive(self, test, node):
+        return True
+
+
+class FileRegister(client_mod.Client):
+    """CAS register persisted to a JSON file, so the register outlives
+    the SIGKILL'd run process."""
+
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def _load(self):
+        try:
+            with open(self.path) as f:
+                return json.load(f)["value"]
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _store(self, v):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"value": v}, f)
+        os.replace(tmp, self.path)
+
+    def invoke(self, test, op):
+        with self._lock:
+            if op.f == "write":
+                self._store(op.value)
+                return op.with_(type="ok")
+            if op.f == "read":
+                return op.with_(type="ok", value=self._load())
+            if op.f == "cas":
+                old, new = op.value
+                if self._load() == old:
+                    self._store(new)
+                    return op.with_(type="ok")
+                return op.with_(type="fail")
+        raise ValueError(f"unknown op {op.f!r}")
+
+
+def _kill_trigger(test, process):
+    """Phase-2 nemesis draw: under KILL_ENV, persist a checkpoint and
+    die mid-run with faults active. In every other mode (straight
+    through, resumed) it emits nothing, keeping schedules identical."""
+    if os.environ.get(KILL_ENV):
+        core.checkpoint_now(test)
+        os.kill(os.getpid(), signal.SIGKILL)
+    return None
+
+
+def build_test(scratch: str) -> dict:
+    db = RecordingProcDB()
+    return {
+        "name": "resume-e2e",
+        "start_time": START_TIME,
+        "store_dir": os.path.join(scratch, "store"),
+        "nodes": list(NODES),
+        "concurrency": 1,
+        "ssh": {"dummy": True},
+        "remote": DummyRemote(),
+        "os": osenv.noop,
+        "db": db,
+        "net": net_mod.noop,
+        "client": FileRegister(os.path.join(scratch, "register.json")),
+        "model": models.cas_register(),
+        "checker": checker_mod.compose({
+            "workload": checker_mod.linearizable(algorithm="host"),
+            "recovery": RecoveryChecker(FAMILIES),
+        }),
+        "nemesis": nem_mod.compose({
+            frozenset({"kill", "restart"}): comb.ProcessNemesis(db, "kill"),
+            frozenset({"pause", "resume"}): comb.ProcessNemesis(db, "pause"),
+        }),
+        # only the explicit kill-trigger checkpoint should decide what
+        # the resumed run sees; keep the periodic ticker out of the way
+        "checkpoint_interval": 3600,
+        "generator": gen.phases(
+            gen.nemesis(gen.seq(list(FAULT_OPS)), gen.seq(list(MAIN_OPS))),
+            gen.nemesis(_kill_trigger),
+            gen.nemesis(gen.seq(list(HEAL_OPS))),
+            gen.clients(gen.seq(list(STABILITY_OPS))),
+        ),
+    }
+
+
+def run_straight(scratch: str) -> dict:
+    """One uninterrupted run; returns the finished test map."""
+    return core.run(build_test(scratch))
+
+
+def resume(scratch: str) -> dict:
+    """Resume the killed run in `scratch` from its checkpoint."""
+    return core.resume(build_test(scratch))
+
+
+def main(argv) -> int:
+    mode, scratch = argv[0], argv[1]
+    os.makedirs(scratch, exist_ok=True)
+    if mode == "killable":
+        os.environ[KILL_ENV] = "1"
+        run_straight(scratch)  # dies by SIGKILL inside phase 2
+        return 70  # reaching here means the trigger never fired
+    if mode == "run":
+        test = run_straight(scratch)
+    elif mode == "resume":
+        test = resume(scratch)
+    else:
+        print(f"unknown mode {mode!r}", file=sys.stderr)
+        return 254
+    return 0 if (test.get("results") or {}).get("valid") is True else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,527 @@
+"""Preemption-tolerance machinery: crash-consistent run checkpoints
+(a kill at any byte leaves a loadable state), generator
+snapshot/restore, WAL session epochs + fsync policies, the nemesis
+active-fault ledger, and the resumable analysis journal."""
+
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import core, store
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem_mod
+from jepsen_tpu.history import Op, invoke_op, ok_op
+from jepsen_tpu.nemesis import combined as comb
+
+
+def t0(**kw):
+    test = {"name": "ckpt-test", "start_time": "20260801T000000.000"}
+    test.update(kw)
+    return test
+
+
+# ---------------------------------------------------------------------------
+# RunCheckpoint durability
+
+class TestRunCheckpoint:
+    def test_round_trip(self):
+        ck = store.RunCheckpoint(t0())
+        ck.write({"v": 1, "cursor": [1, 2, 3]})
+        assert ck.load() == {"v": 1, "cursor": [1, 2, 3]}
+        assert store.load_checkpoint(t0()) == {"v": 1, "cursor": [1, 2, 3]}
+
+    def test_missing_is_none(self):
+        assert store.load_checkpoint(t0()) is None
+
+    def test_second_write_rotates_prev(self):
+        ck = store.RunCheckpoint(t0())
+        ck.write({"v": 1, "n": 1})
+        ck.write({"v": 1, "n": 2})
+        assert ck.load() == {"v": 1, "n": 2}
+        with open(ck.path + ".prev") as f:
+            assert json.load(f) == {"v": 1, "n": 1}
+
+    def test_torn_current_falls_back_to_prev(self):
+        ck = store.RunCheckpoint(t0())
+        ck.write({"n": 1})
+        ck.write({"n": 2})
+        with open(ck.path, "w") as f:
+            f.write('{"n": 2, "cur')  # killed mid-rewrite
+        assert ck.load() == {"n": 1}
+
+    def test_missing_rename_target_falls_back_to_prev(self):
+        # the kill landed between the two os.replace calls: current is
+        # gone but .prev survives
+        ck = store.RunCheckpoint(t0())
+        ck.write({"n": 1})
+        ck.write({"n": 2})
+        os.remove(ck.path)
+        assert ck.load() == {"n": 1}
+
+    def test_stale_tmp_leftover_is_ignored(self):
+        ck = store.RunCheckpoint(t0())
+        ck.write({"n": 1})
+        with open(ck.path + ".tmp", "w") as f:
+            f.write('{"half a check')  # kill mid-tmp-write
+        assert ck.load() == {"n": 1}
+        ck.write({"n": 2})  # next write overwrites the leftover
+        assert ck.load() == {"n": 2}
+        assert not os.path.exists(ck.path + ".tmp")
+
+    def test_both_torn_is_none(self):
+        ck = store.RunCheckpoint(t0())
+        for suffix in ("", ".prev"):
+            with open(ck.path + suffix, "w") as f:
+                f.write("not json")
+        assert ck.load() is None
+
+    def test_kill_at_any_byte_leaves_a_good_checkpoint(self):
+        """Property: after two writes, truncating the current file at
+        ANY byte offset (a mid-write kill) still loads one of the two
+        states — never zero."""
+        rng = random.Random(0xC0FFEE)
+        for trial in range(25):
+            test = t0(start_time=f"trunc-{trial}")
+            ck = store.RunCheckpoint(test)
+            s1 = {"trial": trial, "gen": 1, "pad": "x" * rng.randrange(64)}
+            s2 = {"trial": trial, "gen": 2, "pad": "y" * rng.randrange(64)}
+            ck.write(s1)
+            ck.write(s2)
+            size = os.path.getsize(ck.path)
+            cut = rng.randrange(size + 1)
+            with open(ck.path, "r+") as f:
+                f.truncate(cut)
+            got = ck.load()
+            assert got in (s1, s2), (trial, cut, got)
+
+
+# ---------------------------------------------------------------------------
+# Generator snapshot/restore
+
+TEST = {"concurrency": 2, "nodes": ["n1", "n2"]}
+
+
+def draws(g, n, process=0, test=TEST):
+    out = []
+    for _ in range(n):
+        o = g.op(test, process)
+        if o is None:
+            break
+        out.append(o)
+    return out
+
+
+def drain(g, process=0, test=TEST, cap=10_000):
+    out = []
+    for _ in range(cap):
+        o = g.op(test, process)
+        if o is None:
+            return out
+        out.append(o)
+    raise AssertionError("generator did not terminate")
+
+
+class TestGeneratorSnapshotRestore:
+    def test_phases_cursor_round_trip(self):
+        def build():
+            return gen.phases(
+                gen.seq([{"f": "w", "value": i} for i in range(6)]),
+                gen.once({"f": "end"}),
+            )
+
+        with gen.with_threads([0]):
+            a = build()
+            head = draws(a, 3)
+            snap = gen.snapshot(a)
+            b = build()
+            gen.restore(b, snap)
+            rest_a = drain(a)
+            rest_b = drain(b)
+        assert [o["value"] for o in head] == [0, 1, 2]
+        assert rest_a == rest_b
+        assert [o.get("value", o["f"]) for o in rest_b] == [3, 4, 5, "end"]
+
+    def test_limit_remaining_round_trip(self):
+        a = gen.limit(5, {"f": "r"})
+        draws(a, 2)
+        b = gen.limit(5, {"f": "r"})
+        gen.restore(b, gen.snapshot(a))
+        assert len(drain(b)) == 3
+
+    def test_mix_rng_round_trip(self):
+        def build(seed):
+            return gen.mix([{"f": "a"}, {"f": "b"}, {"f": "c"}],
+                           rng=random.Random(seed))
+
+        a = build(7)
+        draws(a, 5)
+        b = build(999)  # different seed; restore overrides its state
+        gen.restore(b, gen.snapshot(a))
+        assert draws(a, 30) == draws(b, 30)
+
+    def test_time_limit_snapshots_remaining_budget(self):
+        a = gen.time_limit(30, {"f": "r"})
+        draws(a, 1)  # arms the deadline
+        snap = gen.snapshot(a)
+        rem = snap["s"]["remaining"]
+        assert 0 < rem <= 30
+        b = gen.time_limit(30, {"f": "r"})
+        gen.restore(b, snap)
+        o = b.op(TEST, 0)
+        assert o is not None and gen.DEADLINE_KEY in o
+
+    def test_unarmed_time_limit_restores_unarmed(self):
+        a = gen.time_limit(30, {"f": "r"})
+        snap = gen.snapshot(a)
+        assert snap["s"]["remaining"] is None
+        b = gen.time_limit(30, {"f": "r"})
+        gen.restore(b, snap)
+        assert b._deadline is None
+
+    def test_concat_per_process_cursors(self):
+        def build():
+            return gen.concat(gen.seq([{"f": "a1"}, {"f": "a2"}]),
+                              gen.seq([{"f": "b1"}, {"f": "b2"}]))
+
+        a = build()
+        draws(a, 2, process=0)
+        draws(a, 1, process=1)
+        b = build()
+        gen.restore(b, gen.snapshot(a))
+        assert drain(a, process=0) == drain(b, process=0)
+        assert drain(a, process=1) == drain(b, process=1)
+
+    def test_interruptible_is_transparent(self):
+        ev = threading.Event()
+        a = gen.interruptible(gen.limit(4, {"f": "r"}), ev)
+        draws(a, 1)
+        snap = gen.snapshot(a)
+        assert snap["t"] == "Interruptible"
+        b = gen.interruptible(gen.limit(4, {"f": "r"}), threading.Event())
+        gen.restore(b, snap)
+        assert len(drain(b)) == 3
+
+    def test_interruptible_gate_stops_generation(self):
+        ev = threading.Event()
+        g = gen.interruptible(gen.limit(100, {"f": "r"}), ev)
+        assert g.op(TEST, 0) is not None
+        ev.set()
+        assert g.op(TEST, 0) is None
+
+    def test_shape_mismatch_raises(self):
+        snap = gen.snapshot(gen.limit(2, {"f": "r"}))
+        with pytest.raises(ValueError, match="shape mismatch"):
+            gen.restore(gen.once({"f": "r"}), snap)
+
+    def test_snapshot_survives_json(self):
+        """Checkpoints persist through JSON: the snapshot tree must
+        round-trip (tuples become lists; restore must tolerate it)."""
+        def build():
+            return gen.phases(
+                gen.mix([{"f": "a"}, {"f": "b"}], rng=random.Random(3)),
+                gen.once({"f": "end"}),
+            )
+
+        with gen.with_threads([0]):
+            a = build()
+            draws(a, 4)
+            snap = json.loads(json.dumps(
+                gen.snapshot(a), default=store._json_default))
+            b = build()
+            gen.restore(b, snap)
+            assert draws(a, 10) == draws(b, 10)
+
+
+# ---------------------------------------------------------------------------
+# WAL session epochs + fsync policy
+
+HIST = [
+    invoke_op(0, "write", 3, time=10, index=0),
+    ok_op(0, "write", 3, time=20, index=1),
+]
+
+
+class TestWALEpochs:
+    def test_fresh_wal_is_epoch_zero(self):
+        wal = store.HistoryWAL(t0())
+        assert wal.epoch == 0
+        wal.close()
+
+    def test_reopen_advances_epoch(self):
+        test = t0()
+        wal = store.HistoryWAL(test)
+        for o in HIST:
+            wal.append(o)
+        wal.close()
+        wal2 = store.HistoryWAL(test)
+        assert wal2.epoch == 1
+        wal2.close()
+
+    def test_epoch_stamps_stripped_and_reindexed(self):
+        test = t0()
+        wal = store.HistoryWAL(test)
+        for o in HIST:
+            wal.append(o)
+        wal.close()
+        wal2 = store.HistoryWAL(test)
+        wal2.append(invoke_op(1, "read", None, time=30, index=-1))
+        wal2.append(ok_op(1, "read", 3, time=40, index=-1))
+        wal2.close()
+        loaded = store.load_wal_history(test)
+        assert [o.index for o in loaded] == [0, 1, 2, 3]
+        assert [o.f for o in loaded] == ["write", "write", "read", "read"]
+        assert all("_epoch" not in o.extra for o in loaded)
+
+    def test_epochs_order_ops_across_sessions(self):
+        """Even if a tool rewrote the file with sessions interleaved,
+        load sorts by epoch (stable within an epoch) so indices never
+        collide across sessions."""
+        test = t0()
+        p = store.path_(test, store.WAL_FILE)
+        lines = [
+            {"process": 0, "type": "invoke", "f": "b", "_epoch": 1},
+            {"process": 0, "type": "invoke", "f": "a", "_epoch": 0},
+            {"process": 1, "type": "invoke", "f": "c", "_epoch": 1},
+        ]
+        with open(p, "w") as f:
+            for rec in lines:
+                f.write(json.dumps(rec) + "\n")
+        loaded = store.load_wal_history(test)
+        assert [o.f for o in loaded] == ["a", "b", "c"]
+        assert [o.index for o in loaded] == [0, 1, 2]
+
+    def test_torn_tail_still_advances_epoch(self):
+        test = t0()
+        wal = store.HistoryWAL(test)
+        wal.append(HIST[0])
+        wal.close()
+        with open(store.path(test, store.WAL_FILE), "a") as f:
+            f.write('{"process": 2, "type": "inv')  # torn
+        wal2 = store.HistoryWAL(test)
+        assert wal2.epoch == 1
+        wal2.close()
+
+    def test_legacy_unstamped_lines_load_as_epoch_zero(self):
+        test = t0()
+        p = store.path_(test, store.WAL_FILE)
+        with open(p, "w") as f:
+            f.write(json.dumps({"process": 0, "type": "invoke",
+                                "f": "old"}) + "\n")
+        loaded = store.load_wal_history(test)
+        assert [o.f for o in loaded] == ["old"]
+        # and a reopen treats the legacy session as epoch 0
+        wal = store.HistoryWAL(test)
+        assert wal.epoch == 1
+        wal.close()
+
+
+class TestWALFsyncPolicy:
+    def test_default_is_nemesis(self):
+        wal = store.HistoryWAL(t0())
+        assert wal.fsync_policy == "nemesis"
+        wal.close()
+
+    def test_test_map_key_configures(self):
+        wal = store.HistoryWAL(t0(wal_fsync="op"))
+        assert wal.fsync_policy == "op"
+        wal.close()
+
+    def test_invalid_policy_raises(self):
+        with pytest.raises(ValueError, match="wal_fsync"):
+            store.HistoryWAL(t0(wal_fsync="sometimes"))
+
+    @pytest.mark.parametrize("policy,expected", [
+        ("op", 2), ("nemesis", 1), ("close", 0)])
+    def test_fsync_calls_per_policy(self, monkeypatch, policy, expected):
+        calls = []
+        real_fsync = os.fsync
+        monkeypatch.setattr(os, "fsync",
+                            lambda fd: (calls.append(fd), real_fsync(fd)))
+        wal = store.HistoryWAL(t0(start_time=f"fsync-{policy}"),
+                               fsync=policy)
+        wal.append(invoke_op(0, "write", 1, time=0, index=0))
+        wal.append(Op(process="nemesis", type="info", f="start", value=None,
+                      time=1, index=1))
+        assert len(calls) == expected
+        wal.close()  # close always fsyncs
+        assert len(calls) == expected + 1
+
+
+# ---------------------------------------------------------------------------
+# Active-fault ledger protocol
+
+class TestFaultLedger:
+    def test_base_nemesis_has_empty_ledger(self):
+        n = nem_mod.Noop()
+        assert n.active_faults() == []
+        n.restore_faults([{"kind": "x", "heal_f": "y"}])  # no-op
+
+    def test_partitioner_ledger_round_trip(self):
+        a = nem_mod.partition_halves()
+        assert a.active_faults() == []
+        a._grudge = {"n1": ["n2"], "n2": ["n1"]}
+        [entry] = a.active_faults()
+        assert entry["kind"] == "partition" and entry["heal_f"] == "stop"
+        b = nem_mod.partition_halves()
+        b.restore_faults([json.loads(json.dumps(entry))])
+        assert b._grudge == {"n1": ["n2"], "n2": ["n1"]}
+
+    def test_clock_ledger(self):
+        a = nem_mod.clock_scrambler(5)
+        assert a.active_faults() == []
+        a._scrambled = True
+        [entry] = a.active_faults()
+        assert entry == {"kind": "clock", "heal_f": "reset"}
+        b = nem_mod.clock_scrambler(5)
+        b.restore_faults([entry])
+        assert b._scrambled is True
+
+    def test_process_nemesis_ledger(self):
+        class FakeProcDB:
+            def kill_processes(self, test, node):
+                pass
+
+            def restart_processes(self, test, node):
+                pass
+
+        a = comb.ProcessNemesis(FakeProcDB(), mode="kill")
+        a.affected.update(["n2", "n1"])
+        [entry] = a.active_faults()
+        assert entry["kind"] == "process-kill"
+        assert entry["heal_f"] == a.heal_f
+        assert entry["nodes"] == ["n1", "n2"]
+        b = comb.ProcessNemesis(FakeProcDB(), mode="kill")
+        b.restore_faults([entry])
+        assert set(b.affected) == {"n1", "n2"}
+
+    def test_packet_ledger(self):
+        a = comb.PacketNemesis()
+        assert a.active_faults() == []
+        a._behavior = "flaky"
+        [entry] = a.active_faults()
+        assert entry == {"kind": "packet", "heal_f": "packet-stop",
+                         "behavior": "flaky"}
+        b = comb.PacketNemesis()
+        b.restore_faults([entry])
+        assert b._behavior == "flaky"
+
+    def test_compose_translates_heal_f_to_outer_name(self):
+        part = nem_mod.partition_halves()
+        part._grudge = {"n1": ["n2"]}
+        clock = nem_mod.clock_scrambler(5)
+        clock._scrambled = True
+        rename = comb._FDict({"part-start": "start", "part-stop": "stop"})
+        c = nem_mod.Compose({
+            rename: part,
+            frozenset({"scramble", "reset"}): clock,
+        })
+        faults = c.active_faults()
+        by_kind = {e["kind"]: e for e in faults}
+        assert by_kind["partition"]["heal_f"] == "part-stop"
+        assert by_kind["clock"]["heal_f"] == "reset"
+        # and restore routes back through the rename map
+        part2 = nem_mod.partition_halves()
+        clock2 = nem_mod.clock_scrambler(5)
+        c2 = nem_mod.Compose({
+            comb._FDict({"part-start": "start", "part-stop": "stop"}): part2,
+            frozenset({"scramble", "reset"}): clock2,
+        })
+        c2.restore_faults([json.loads(json.dumps(e)) for e in faults])
+        assert part2._grudge == {"n1": ["n2"]}
+        assert clock2._scrambled is True
+
+    def test_compose_drops_unroutable_entries(self):
+        c = nem_mod.Compose({frozenset({"reset"}):
+                             nem_mod.clock_scrambler(5)})
+        c.restore_faults([{"kind": "ghost", "heal_f": "exorcise"}])  # logs
+
+
+# ---------------------------------------------------------------------------
+# checkpoint_state / checkpoint_now wiring
+
+class TestCheckpointState:
+    def _test_map(self):
+        part = nem_mod.partition_halves()
+        part._grudge = {"n1": ["n2"]}
+        return t0(
+            generator=gen.limit(3, {"f": "r"}),
+            nemesis=part,
+            _history=[HIST[0]],
+        )
+
+    def test_state_shape(self):
+        test = self._test_map()
+        state = core.checkpoint_state(test)
+        assert state["v"] == 1
+        assert state["generator"]["t"] == "Limit"
+        assert state["faults"][0]["kind"] == "partition"
+        assert state["processes"] == []
+        assert state["wal_count"] == 1
+        assert state["wall_clock"] > 0
+
+    def test_checkpoint_now_without_store_is_none(self):
+        test = self._test_map()
+        assert core.checkpoint_now(test) is None
+
+    def test_checkpoint_now_writes_loadable_state(self):
+        test = self._test_map()
+        test["_ckpt"] = store.RunCheckpoint(test)
+        p = core.checkpoint_now(test)
+        assert p and os.path.exists(p)
+        loaded = store.load_checkpoint(test)
+        assert loaded["faults"][0]["grudge"] == {"n1": ["n2"]}
+        # the persisted generator snapshot restores into a fresh twin
+        b = gen.limit(3, {"f": "r"})
+        gen.restore(b, loaded["generator"])
+        assert len(drain(b)) == 3
+
+
+# ---------------------------------------------------------------------------
+# AnalysisJournal
+
+class TestAnalysisJournal:
+    def test_record_and_reload(self):
+        test = t0()
+        j = store.AnalysisJournal(test)
+        assert len(j) == 0
+        j.record("independent-key", ("k", 1), {"valid": True})
+        j.record("closure", "abc123", {"n": 2, "bits": "c0"})
+        j.close()
+        j2 = store.AnalysisJournal(test)
+        assert len(j2) == 2
+        assert j2.contains("independent-key", ("k", 1))
+        assert j2.get("independent-key", ("k", 1)) == {"valid": True}
+        assert j2.get("closure", "abc123") == {"n": 2, "bits": "c0"}
+        assert j2.get("closure", "nope") is None
+        j2.close()
+
+    def test_duplicate_record_is_idempotent(self):
+        test = t0()
+        j = store.AnalysisJournal(test)
+        j.record("closure", "k", {"n": 1})
+        j.record("closure", "k", {"n": 999})
+        assert j.get("closure", "k") == {"n": 1}
+        j.close()
+        with open(j.path) as f:
+            assert len(f.readlines()) == 1
+
+    def test_torn_tail_tolerated(self):
+        test = t0()
+        j = store.AnalysisJournal(test)
+        j.record("closure", "good", {"n": 1})
+        j.close()
+        with open(j.path, "a") as f:
+            f.write('{"kind": "closure", "key": "to')
+        j2 = store.AnalysisJournal(test)
+        assert len(j2) == 1
+        assert j2.get("closure", "good") == {"n": 1}
+        # appending after a torn tail still works: the torn line is a
+        # prefix of the new one's line, but records are line-oriented
+        j2.record("closure", "next", {"n": 2})
+        j2.close()
+        j3 = store.AnalysisJournal(test)
+        assert j3.get("closure", "next") == {"n": 2}
+        j3.close()

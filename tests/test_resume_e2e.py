@@ -1,0 +1,211 @@
+"""Preemption-tolerance e2e (chaos): SIGKILL a run mid-flight with
+faults active, resume it from its crash-consistent checkpoint, and
+require that (1) every leftover fault is healed before the first
+resumed op and (2) the final verdict is bit-identical to an
+uninterrupted same-schedule run. Plus: resumable analysis of ≥5k-op
+histories must skip all previously-journaled independent keys and
+closure components (verified through supervisor journal_skips
+telemetry)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import core, independent, store
+from jepsen_tpu.checker import cycle, linearizable
+from jepsen_tpu.checker import supervisor as sup_mod
+from jepsen_tpu.history import index, invoke_op, ok_op
+from jepsen_tpu.independent import tuple_
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.workloads import list_append
+from tests import resume_driver as driver
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _strip_supervision(x):
+    """Supervision telemetry describes the machine the analysis ran
+    on, not the history — it's the one legitimately run-dependent
+    result key, so verdict comparisons drop it."""
+    if isinstance(x, dict):
+        return {k: _strip_supervision(v) for k, v in x.items()
+                if k != "supervision"}
+    if isinstance(x, list):
+        return [_strip_supervision(v) for v in x]
+    return x
+
+
+def _run_dir(scratch: str) -> str:
+    return os.path.join(scratch, "store", "resume-e2e", driver.START_TIME)
+
+
+def _load_results(scratch: str) -> dict:
+    with open(os.path.join(_run_dir(scratch), "results.json")) as f:
+        return json.load(f)
+
+
+def _wal_lines(scratch: str) -> list:
+    p = os.path.join(_run_dir(scratch), store.WAL_FILE)
+    out = []
+    with open(p) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass  # torn tail from the kill
+    return out
+
+
+@pytest.mark.chaos
+class TestSigkillResume:
+    def test_kill_resume_matches_uninterrupted_run(self, tmp_path):
+        # Leg 1: the reference — one uninterrupted run of the fixed
+        # schedule.
+        a = driver.run_straight(str(tmp_path / "a"))
+        assert a["results"]["valid"] is True
+
+        # Leg 2: same schedule in a subprocess that checkpoints and
+        # SIGKILLs itself between the fault phase and the heal phase.
+        scratch_b = str(tmp_path / "b")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop(driver.KILL_ENV, None)  # killable mode sets it itself
+        proc = subprocess.run(
+            [sys.executable, "-m", "tests.resume_driver",
+             "killable", scratch_b],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=240,
+        )
+        assert proc.returncode == -signal.SIGKILL, (
+            proc.returncode, proc.stdout[-1000:], proc.stderr[-1000:])
+
+        # The kill left a checkpoint whose ledger carries both still-
+        # active faults.
+        with open(os.path.join(_run_dir(scratch_b),
+                               store.CKPT_FILE)) as f:
+            ckpt = json.load(f)
+        kinds = sorted(e["kind"] for e in ckpt["faults"])
+        assert kinds == ["process-kill", "process-pause"]
+
+        # Leg 3: resume in-process to the original budget.
+        b = driver.resume(scratch_b)
+        assert b["results"]["valid"] is True
+
+        # Heal-first contract: in the resumed epoch, every WAL line
+        # before the first client op is a nemesis op, and the
+        # resume_heal-tagged ops among them cover both leftover faults.
+        lines = _wal_lines(scratch_b)
+        epochs = {ln.get("_epoch", 0) for ln in lines}
+        assert epochs == {0, 1}
+        resumed = [ln for ln in lines if ln.get("_epoch", 0) == 1]
+        pre_client = []
+        for ln in resumed:
+            if ln["process"] != "nemesis":
+                break
+            pre_client.append(ln)
+        assert pre_client, "no nemesis ops before the first resumed op"
+        healed = {ln["f"] for ln in pre_client if ln.get("resume_heal")}
+        assert healed == {"restart", "resume"}
+        # and the faults really were planted in the killed epoch
+        killed = [ln for ln in lines if ln.get("_epoch", 0) == 0]
+        assert {"kill", "pause"} <= {ln["f"] for ln in killed}
+
+        # Session epochs keep op indices collision-free: the stitched
+        # history is indexed 0..n-1 with no duplicates (satellite a).
+        idxs = [o.index for o in b["history"]]
+        assert idxs == list(range(len(idxs)))
+
+        # The acceptance bar: persisted verdicts are bit-identical.
+        ra = _strip_supervision(_load_results(str(tmp_path / "a")))
+        rb = _strip_supervision(_load_results(scratch_b))
+        assert ra == rb
+
+
+def _keyed_history(keys: int, rounds: int):
+    """A linearizable multi-key CAS history: keys*rounds*4 ops."""
+    ops = []
+    for k in range(keys):
+        key = f"k{k}"
+        for i in range(rounds):
+            ops += [
+                invoke_op(0, "write", tuple_(key, i)),
+                ok_op(0, "write", tuple_(key, i)),
+                invoke_op(1, "read", tuple_(key, None)),
+                ok_op(1, "read", tuple_(key, i)),
+            ]
+    return index(ops)
+
+
+def _journal_lines(test, kind: str) -> int:
+    p = store.path(test, store.ANALYSIS_CKPT_FILE)
+    with open(p) as f:
+        return sum(1 for line in f
+                   if line.strip() and json.loads(line)["kind"] == kind)
+
+
+def _normalize(results: dict):
+    return _strip_supervision(
+        json.loads(json.dumps(results, default=store._json_default)))
+
+
+@pytest.mark.chaos
+class TestResumableAnalysis:
+    START = "20260805T010000.000"
+
+    def test_rerun_skips_all_independent_keys(self, tmp_path):
+        """Re-analyzing a 5,000-op keyed history reuses every journaled
+        per-key verdict: journal_skips grows by exactly the key count
+        and the journal gains no new lines."""
+        hist = _keyed_history(keys=125, rounds=10)  # 5,000 ops
+        assert len(hist) == 5000
+        base = {
+            "name": "ana-indep", "start_time": self.START,
+            "store_dir": str(tmp_path),
+            "checker": independent.checker(
+                linearizable(CASRegister(), algorithm="host")),
+        }
+        tele = sup_mod.get().telemetry
+
+        s0 = tele.snapshot()["journal_skips"]
+        t1 = core.analyze({**base, "history": list(hist)})
+        s1 = tele.snapshot()["journal_skips"]
+        assert t1["results"]["valid"] is True
+        assert s1 == s0  # fresh journal: nothing to skip
+        n_lines = _journal_lines(t1, "independent-key")
+        assert n_lines == 125
+
+        t2 = core.analyze({**base, "history": list(hist)})
+        s2 = tele.snapshot()["journal_skips"]
+        assert s2 - s1 == 125  # every key skipped
+        assert _journal_lines(t2, "independent-key") == n_lines
+        assert _normalize(t2["results"]) == _normalize(t1["results"])
+
+    def test_rerun_skips_all_closure_components(self, tmp_path):
+        """Re-analyzing a 5,000-op transactional history reuses every
+        journaled component-closure: the closure supervisor's
+        journal_skips grows by the job count and no closures rerun."""
+        hist = list_append.simulate(5000, seed=42)
+        assert len(hist) >= 5000
+        base = {
+            "name": "ana-closure", "start_time": self.START,
+            "store_dir": str(tmp_path),
+            "checker": cycle.checker(engine="host"),
+        }
+        tele = sup_mod.get_closure().telemetry
+
+        s0 = tele.snapshot()["journal_skips"]
+        t1 = core.analyze({**base, "history": list(hist)})
+        s1 = tele.snapshot()["journal_skips"]
+        assert s1 == s0  # fresh journal: nothing to skip
+        jobs = _journal_lines(t1, "closure")
+        assert jobs > 0
+
+        t2 = core.analyze({**base, "history": list(hist)})
+        s2 = tele.snapshot()["journal_skips"]
+        assert s2 - s1 == jobs  # every component x mask job skipped
+        assert _journal_lines(t2, "closure") == jobs
+        assert _normalize(t2["results"]) == _normalize(t1["results"])

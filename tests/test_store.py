@@ -281,6 +281,25 @@ class TestHistoryWAL:
         assert len(wal_ops) == len(test["history"])
         assert "_wal" not in test  # closed and detached after the run
 
+    def test_wal_reopen_appends_under_new_epoch(self):
+        """A resumed run reopens the WAL: session epochs keep
+        load_history's fallback indices monotonic and collision-free
+        across sessions (the old loader reindexed by arrival order only,
+        which collides once two sessions both start at index -1)."""
+        test = t0()
+        wal = store.HistoryWAL(test)
+        for o in HIST[:2]:
+            wal.append(o)
+        wal.close()
+        wal2 = store.HistoryWAL(test)
+        assert wal2.epoch == wal.epoch + 1
+        for o in HIST[2:]:
+            wal2.append(o.with_(index=-1))
+        wal2.close()
+        loaded = store.load_history(test)
+        assert [o.index for o in loaded] == list(range(len(HIST)))
+        assert [o.f for o in loaded] == [o.f for o in HIST]
+
     def test_wal_fallback_reindexes_live_ops(self):
         """conj_op journals ops BEFORE finalization assigns indices
         (index=-1 on disk); the fallback loader must reindex in arrival

@@ -29,11 +29,20 @@ IDENTICAL verdicts and anomaly taxonomies through both engines, and the
 raw closure matrices must agree exactly on seeded random digraphs.
 Their parity lands under "cycle" in the summary.
 
+Resumable analysis replays too: a keyed register history and a
+transactional history are analyzed with a fresh analysis journal, the
+journal is truncated mid-file (the preempted-analysis shape), and the
+re-run must reuse every surviving journaled verdict (counted via the
+supervisors' journal_skips telemetry) while producing a verdict
+identical to the uninterrupted pass. That parity lands under "resume"
+in the summary.
+
 Writes a machine-readable summary to PARITY.json at the repo root
 (backend, interpret flag, corpus size, per-engine
-checked/matched/mismatches/skipped, cycle-engine anomaly parity) and
-exits 0 iff no engine contradicted any expected verdict and the cycle
-engines agreed throughout.
+checked/matched/mismatches/skipped, cycle-engine anomaly parity,
+resumable-analysis parity) and exits 0 iff no engine contradicted any
+expected verdict, the cycle engines agreed throughout, and resumed
+analysis matched uninterrupted analysis.
 
 Usage:  python tools/replay_parity.py  [--out PATH]
 """
@@ -370,6 +379,97 @@ def replay_cycle(on_tpu: bool) -> dict:
     return out
 
 
+def _strip_supervision(x):
+    """Supervision telemetry is machine-dependent; verdict parity
+    compares everything else."""
+    if isinstance(x, dict):
+        return {k: _strip_supervision(v) for k, v in x.items()
+                if k != "supervision"}
+    if isinstance(x, list):
+        return [_strip_supervision(v) for v in x]
+    return x
+
+
+def replay_resume() -> dict:
+    """Resumable-analysis parity (store.AnalysisJournal): analyze a
+    history with a fresh journal, truncate the journal mid-file — the
+    shape a preempted analysis pass leaves behind — and re-run. The
+    resumed verdict must equal the uninterrupted one, and the surviving
+    journal entries must actually be reused (journal_skips telemetry >
+    0), or the journal is dead weight."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu import core, independent, store
+    from jepsen_tpu.checker import cycle, linearizable
+    from jepsen_tpu.checker import supervisor as sup_mod
+    from jepsen_tpu.history import index, invoke_op, ok_op
+    from jepsen_tpu.independent import tuple_
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.workloads import list_append
+
+    t0 = time.monotonic()
+    out: dict = {"cases": 0, "parity": True, "mismatches": [],
+                 "journal_skips": 0, "failures": 0}
+
+    def norm(results):
+        return _strip_supervision(json.loads(
+            json.dumps(results, default=store._json_default)))
+
+    def one(name, base, hist, sup_fn):
+        out["cases"] += 1
+        try:
+            t1 = core.analyze({**base, "history": list(hist)})
+            jp = store.path(t1, store.ANALYSIS_CKPT_FILE)
+            with open(jp) as fh:
+                lines = [ln for ln in fh if ln.strip()]
+            with open(jp, "w") as fh:  # keep only the first half
+                fh.writelines(lines[:len(lines) // 2])
+            s0 = sup_fn().telemetry.snapshot()["journal_skips"]
+            t2 = core.analyze({**base, "history": list(hist)})
+            skips = sup_fn().telemetry.snapshot()["journal_skips"] - s0
+            out["journal_skips"] += skips
+            if norm(t1["results"]) != norm(t2["results"]):
+                out["parity"] = False
+                out["mismatches"].append(
+                    {"case": name, "kind": "verdict"})
+            elif len(lines) >= 2 and skips == 0:
+                out["parity"] = False
+                out["mismatches"].append(
+                    {"case": name, "kind": "journal unused"})
+        except Exception as e:  # noqa: BLE001 — counted, not fatal
+            out["failures"] += 1
+            log(f"  resume: {name} failed ({e!r}); counted")
+
+    tmp = tempfile.mkdtemp(prefix="replay-resume-")
+    try:
+        ops = []
+        for k in range(40):
+            for i in range(10):
+                key = f"k{k}"
+                ops += [
+                    invoke_op(0, "write", tuple_(key, i)),
+                    ok_op(0, "write", tuple_(key, i)),
+                    invoke_op(1, "read", tuple_(key, None)),
+                    ok_op(1, "read", tuple_(key, i)),
+                ]
+        one("independent-keys",
+            {"name": "resume-indep", "start_time": "20260805T000000.000",
+             "store_dir": tmp,
+             "checker": independent.checker(
+                 linearizable(CASRegister(), algorithm="host"))},
+            index(ops), sup_mod.get)
+        one("closure-components",
+            {"name": "resume-closure", "start_time": "20260805T000000.000",
+             "store_dir": tmp, "checker": cycle.checker(engine="host")},
+            list_append.simulate(1200, seed=7), sup_mod.get_closure)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = out["parity"] and not out["failures"]
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=os.path.join(ROOT, "PARITY.json"),
@@ -408,8 +508,12 @@ def main(argv=None) -> int:
     cycle_out = replay_cycle(on_tpu)
     log(f"  cycle: {cycle_out}")
 
+    log("replaying resumable analysis ...")
+    resume_out = replay_resume()
+    log(f"  resume: {resume_out}")
+
     ok = (all(not e.get("mismatches") for e in engines.values())
-          and cycle_out["ok"])
+          and cycle_out["ok"] and resume_out["ok"])
     # supervision telemetry (per-engine failure kinds, demotions,
     # breaker trips) for any checks that routed through the supervisor
     # during the replay — zeros on a healthy run
@@ -426,6 +530,7 @@ def main(argv=None) -> int:
         "corpus_size": len(cases),
         "engines": engines,
         "cycle": cycle_out,
+        "resume": resume_out,
         "supervision": supervision,
         "ok": ok,
     }
