@@ -46,6 +46,13 @@ Summary/artifact fields:
                                        random digraphs, matrix parity
                                        asserted, plus the 5k
                                        list-append anomaly e2e
+                 + serve_daemon        the resident verdict service:
+                                       AOT bundle cold-build vs
+                                       warm-start walls (fresh
+                                       subprocess each) + first-verdict
+                                       latency + sustained ops/s over a
+                                       100-history mixed queue through
+                                       the daemon worker
                  + tpu-vs-native       the crossover matrix (VERDICT r2
                                        item 2): the SAME batch checked
                                        by the native C++ engine, the
@@ -840,6 +847,16 @@ def main():
     log(f"cycle_closure list-append-5k: {cyc['list-append-5k']}")
     configs["cycle_closure"] = cyc
 
+    # ------------------------------------------------------------------
+    # serve_daemon: resident verdict service — bundle cold/warm start
+    # walls + sustained queue throughput (ISSUE 16)
+    try:
+        configs["serve_daemon"] = bench_serve_daemon(run_seed)
+    except Exception as e:  # noqa: BLE001 — the serve lane must not
+        #                     sink the whole capture
+        log(f"serve_daemon lane failed: {e!r}")
+        configs["serve_daemon"] = {"error": repr(e)}
+
     # Backend provenance on EVERY artifact level (VERDICT r4 item 1):
     # the r4 capture's only backend marker lived in the metric string,
     # which the driver's tail truncation ate. Top-level field + a field
@@ -849,6 +866,140 @@ def main():
             c["backend"] = backend
     emit_summary(configs, backend, north_star_ops_s, elapsed, cold,
                  run_seed)
+
+
+# ---------------------------------------------------------------------------
+# serve_daemon: the resident verdict service (jepsen_tpu/serve/)
+
+#: subprocess body for the bundle cold/warm timing: a FRESH process per
+#: measurement, because in-process jit caches would make the second
+#: ensure() warm for the wrong reason. Prints one JSON line.
+_BUNDLE_PROBE = r"""
+import json, sys, tempfile, time
+
+bundle_dir = sys.argv[1]
+from jepsen_tpu.serve import daemon as daemon_mod
+from jepsen_tpu.serve.bundle import EngineBundle
+from jepsen_tpu.serve.queue import DurableQueue
+from jepsen_tpu.serve.registry import EngineRegistry
+
+b = EngineBundle(bundle_dir)
+t0 = time.monotonic()
+state = b.ensure()
+ensure_s = time.monotonic() - t0
+
+# ...then the daemon's first REAL verdict on the warmed engines
+reg = EngineRegistry(None)
+reg.bundle_state = state
+q = DurableQueue(tempfile.mkdtemp())
+hist = [
+    {"process": 0, "type": "invoke", "f": "write", "value": ["x", 1],
+     "time": 0},
+    {"process": 0, "type": "ok", "f": "write", "value": ["x", 1],
+     "time": 1},
+    {"process": 1, "type": "invoke", "f": "read", "value": ["x", None],
+     "time": 2},
+    {"process": 1, "type": "ok", "f": "read", "value": ["x", 1],
+     "time": 3},
+]
+dm = daemon_mod.VerdictDaemon(q, reg)
+t0 = time.monotonic()
+jid = q.submit("bench", "register", hist)
+dm.start()
+v = q.wait_for_verdict(jid, timeout=600)
+first_verdict_s = time.monotonic() - t0
+dm.draining.set()
+print(json.dumps({"warm": bool(state["warm"]),
+                  "ensure_s": round(ensure_s, 3),
+                  "first_verdict_s": round(first_verdict_s, 3),
+                  "valid": None if v is None else v.get("valid")}))
+"""
+
+
+def bench_serve_daemon(run_seed: int) -> dict:
+    """The resident-service lane: AOT bundle cold-build vs warm-start
+    walls (fresh subprocess each, so process-local jit caches can't
+    fake warmth), then sustained throughput over a 100-history mixed
+    queue — many clients, mixed shapes and verdicts — through the real
+    daemon worker (cross-run packing included)."""
+    import random as _random
+    import shutil
+    import tempfile
+
+    from jepsen_tpu.serve.daemon import VerdictDaemon
+    from jepsen_tpu.serve.queue import DurableQueue
+    from jepsen_tpu.serve.registry import EngineRegistry
+
+    out = {}
+    bundle_dir = tempfile.mkdtemp(prefix="jtpu-bench-bundle-")
+    shutil.rmtree(bundle_dir, ignore_errors=True)
+    for label in ("cold", "warm"):
+        p = subprocess.run(
+            [sys.executable, "-c", _BUNDLE_PROBE, bundle_dir],
+            capture_output=True, text=True, timeout=900)
+        try:
+            rec = json.loads(p.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            log(f"serve_daemon {label} probe failed: "
+                f"{p.stderr.strip()[-500:]}")
+            out[f"bundle_{label}"] = {"error": f"rc={p.returncode}"}
+            continue
+        assert rec["valid"] is True, rec
+        assert rec["warm"] == (label == "warm"), rec
+        out[f"bundle_{label}"] = rec
+        log(f"serve_daemon bundle_{label}: {rec}")
+    cold = (out.get("bundle_cold") or {}).get("ensure_s")
+    warm = (out.get("bundle_warm") or {}).get("ensure_s")
+    # the acceptance number: what a warm daemon start pays before its
+    # first verdict can flow (stale bundles pay bundle_cold instead)
+    out["cold_compile_s"] = {"bundle_cold": cold, "bundle_warm": warm}
+
+    # sustained: 100 mixed histories queued across 5 clients; this
+    # process's engines are already warm from the earlier lanes, so
+    # this measures steady-state service throughput, not compiles
+    rng = _random.Random(run_seed + 4242)
+    reg = EngineRegistry(None)
+    q = DurableQueue(tempfile.mkdtemp(prefix="jtpu-bench-queue-"))
+    dm = VerdictDaemon(q, reg)
+    dm.start()
+    total_ops = 0
+    expected, ids = [], []
+    t0 = time.monotonic()
+    for i in range(100):
+        good = rng.random() < 0.8
+        hist, t = [], 0
+        for k in range(rng.choice((1, 2, 4))):
+            key = f"k{i}.{k}"
+            for val in (1, 2, 3):
+                hist.append({"process": k, "type": "invoke",
+                             "f": "write", "value": [key, val],
+                             "time": t})
+                hist.append({"process": k, "type": "ok", "f": "write",
+                             "value": [key, val], "time": t + 1})
+                t += 2
+            read = 3 if good else 99
+            hist.append({"process": k, "type": "invoke", "f": "read",
+                         "value": [key, None], "time": t})
+            hist.append({"process": k, "type": "ok", "f": "read",
+                         "value": [key, read], "time": t + 1})
+            t += 2
+        total_ops += len(hist)
+        expected.append(good)
+        ids.append(q.submit(f"client-{i % 5}", "register", hist,
+                            weight=1 + (i % 5 == 0)))
+    for jid, good in zip(ids, expected):
+        v = q.wait_for_verdict(jid, timeout=600)
+        assert v is not None and v.get("valid") is good, (jid, good, v)
+    elapsed = time.monotonic() - t0
+    dm.draining.set()
+    out["sustained"] = {
+        "histories": len(ids),
+        "ops": total_ops,
+        "wall_s": round(elapsed, 3),
+        "ops_per_s": round(total_ops / elapsed, 1),
+    }
+    log(f"serve_daemon sustained: {out['sustained']}")
+    return out
 
 
 SUMMARY_MAX_BYTES = 1_500
@@ -903,6 +1054,12 @@ def emit_summary(configs, backend, north_star_ops_s, elapsed, cold,
             deep[name] = d
     if deep:
         summary["deep"] = deep
+    serve = configs.get("serve_daemon") or {}
+    if isinstance(serve.get("cold_compile_s"), dict):
+        summary["serve"] = dict(serve["cold_compile_s"])
+        if isinstance(serve.get("sustained"), dict):
+            summary["serve"]["sustained_ops_s"] = \
+                serve["sustained"].get("ops_per_s")
     # supervision telemetry for the whole bench run (retries, demotions,
     # breaker trips...): an all-healthy run reports {} and costs ~20
     # bytes; a degraded run's numbers are exactly what you want in the

@@ -40,16 +40,28 @@ must never preempt the C++ engine), no native toolchain to race
 against, or a failed measurement.  The result (or the failure) is
 cached per-process; ``JEPSEN_TPU_BATCH_MIN`` overrides everything for
 operators who already know their crossover.
+
+A successful measurement is also persisted to an **on-disk cache**
+(``JEPSEN_TPU_CALIB_CACHE``; default
+``~/.cache/jepsen-tpu/calibration.json``; ``off`` disables) stamped
+with the backend/device fingerprint, so warm starts — the resident
+daemon's AOT bundle as much as repeated one-shot runs — skip the
+multi-second re-measurement.  A cache whose fingerprint no longer
+matches the running backend is silently ignored and overwritten by the
+next measurement: stale economics must never route a verdict.
+``_reset_for_tests`` only drops the in-memory cache; tests point the
+env var at a scratch file to isolate the disk layer.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import random
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import asdict, dataclass
 
 log = logging.getLogger("jepsen_tpu.checker.calibrate")
 
@@ -64,6 +76,7 @@ CAL_MIN = 1024          # never escalate below one thousand-ish lanes —
 CAL_MAX = 1 << 20       # "never": pallas loses at any realistic width
 
 _ENV = "JEPSEN_TPU_BATCH_MIN"
+_CACHE_ENV = "JEPSEN_TPU_CALIB_CACHE"
 
 _lock = threading.Lock()
 _cached = False
@@ -133,6 +146,72 @@ def _corrupt_register_lanes(n_lanes: int, seed: int = 0) -> list:
     return lanes
 
 
+# ---------------------------------------------------------------------------
+# On-disk cache (satellite of the resident-service work): a measured
+# crossover is a property of (backend, device kind, jax build), not of
+# one process — persist it, fingerprint-stamped, so warm starts skip
+# the re-measurement the same way the AOT bundle skips recompiles.
+
+def cache_path() -> str | None:
+    """The calibration cache file, or None when disabled."""
+    p = os.environ.get(_CACHE_ENV)
+    if p is None:
+        p = os.path.join(os.path.expanduser("~"), ".cache",
+                         "jepsen-tpu", "calibration.json")
+    return None if p.lower() in ("", "0", "off", "none") else p
+
+
+def device_fingerprint() -> dict:
+    """The backend identity a cached measurement is valid for.  Any
+    mismatch — different platform, device generation, device count, or
+    jax build — marks the cache stale: dispatch economics measured on
+    one backend must never route verdicts on another."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "platform": str(dev.platform),
+        "device_kind": str(getattr(dev, "device_kind", dev)),
+        "device_count": int(jax.device_count()),
+        "jax": str(jax.__version__),
+    }
+
+
+def _load_disk_cache() -> Calibration | None:
+    """A fingerprint-fresh cached Calibration, or None (missing,
+    unparseable, or stale — all equally a miss)."""
+    p = cache_path()
+    if not p:
+        return None
+    try:
+        with open(p) as f:
+            rec = json.load(f)
+        if rec.get("fingerprint") != device_fingerprint():
+            log.info("calibration cache %s is stale for this backend; "
+                     "remeasuring", p)
+            return None
+        c = rec["calibration"]
+        return Calibration(float(c["t_rt"]), float(c["per_lane_pallas"]),
+                           float(c["per_lane_native"]))
+    except Exception:  # noqa: BLE001 — a bad cache is just a miss
+        log.debug("calibration cache unreadable", exc_info=True)
+        return None
+
+
+def _save_disk_cache(cal: Calibration) -> None:
+    p = cache_path()
+    if not p:
+        return
+    try:
+        from .. import store
+
+        store.atomic_write_json(
+            p, {"fingerprint": device_fingerprint(),
+                "calibration": asdict(cal)})
+    except Exception:  # noqa: BLE001 — persistence is best-effort
+        log.debug("couldn't persist calibration cache", exc_info=True)
+
+
 def _measure() -> Calibration | None:
     """Run the actual measurement.  Only called on a real TPU backend
     with a working native toolchain (gated by batch_min)."""
@@ -185,6 +264,13 @@ def calibration() -> Calibration | None:
                 from ..ops import wgl_native
                 from . import supervisor as sup_mod
 
+                cal = _load_disk_cache()
+                if cal is not None:
+                    log.info(
+                        "calibration cache hit: batch_min=%d "
+                        "(skipping re-measurement)", cal.batch_min)
+                    _calibration, _cached = cal, True
+                    return _calibration
                 sup = sup_mod.get()
                 if not (sup.healthy("pallas") and sup.healthy("native")):
                     # a quarantined entrant can't race fairly (or at
@@ -195,6 +281,7 @@ def calibration() -> Calibration | None:
                 #                        race — constant fallback
                 cal = _measure()
                 if cal is not None:
+                    _save_disk_cache(cal)
                     log.info(
                         "calibrated pallas crossover: t_rt=%.1fms "
                         "pallas=%.3fms/lane native=%.3fms/lane -> "
@@ -224,8 +311,22 @@ def batch_min() -> int | None:
     return None if cal is None else cal.batch_min
 
 
+def seed(cal: Calibration | None) -> None:
+    """Install a previously-measured Calibration as this process's
+    cached measurement without re-measuring — the AOT engine bundle's
+    warm-start path (jepsen_tpu/serve/bundle.py), which persists the
+    calibration next to the compile-cache manifest. Callers are
+    responsible for freshness (the bundle's fingerprint check)."""
+    global _cached, _calibration
+    with _lock:
+        _calibration = cal
+        _cached = True
+
+
 def _reset_for_tests() -> None:
-    """Drop the cache (test hook)."""
+    """Drop the in-memory cache (test hook). The on-disk cache is NOT
+    touched — tests isolate it by pointing JEPSEN_TPU_CALIB_CACHE at a
+    scratch file (or "off")."""
     global _cached, _calibration
     with _lock:
         _cached = False

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 from .. import Checker
 from ...history import ops as _ops
-from ...independent import is_tuple
 from . import deps as _deps
 from .anomalies import ANOMALIES, classify
 from .deps import DepGraph, IllegalInference, extract
@@ -133,6 +132,10 @@ class CycleChecker(Checker):
         """Unwrap KVTuple txn values when used OUTSIDE independent's
         sharding (a global run over a keyed history): namespace every
         micro-op key with the tuple key so inference stays per-key."""
+        # lazy: independent imports checker, so a module-level import
+        # here would make the package unimportable whenever independent
+        # happens to be the first jepsen_tpu module loaded
+        from ...independent import is_tuple
         v = o.value
         if not is_tuple(v) or not isinstance(v.value, (list, tuple)):
             return o

@@ -419,14 +419,13 @@ class Linearizable(Checker):
                            deadline: float | None = None) -> list:
         """WGLResults for a flat list of (sub_model, Entries) component
         lanes (pcomp.split output), batched per DISTINCT sub-model —
-        the engines take one model per batch call. Queue components
-        share one UnorderedQueue; a multi-register split yields one
-        Register per distinct initial value (usually just one)."""
+        the engines take one model per batch call (grouping shared
+        with the serve daemon's cross-run packer via
+        pcomp.group_lanes)."""
+        from ..ops import pcomp
+
         out: list = [None] * len(comp_lanes)
-        groups: dict = {}
-        for i, (m, _es) in enumerate(comp_lanes):
-            groups.setdefault(m, []).append(i)
-        for m, idxs in groups.items():
+        for m, idxs in pcomp.group_lanes(comp_lanes).items():
             rs = self._auto_results(
                 m, [comp_lanes[i][1] for i in idxs], batch_kw,
                 deadline=deadline)

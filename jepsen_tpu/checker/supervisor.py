@@ -475,6 +475,21 @@ class Supervisor:
             self.telemetry.record("breaker_trips")
             log.warning("circuit breaker tripped for %s (%s)", engine, e)
 
+    def health_snapshot(self) -> dict:
+        """One JSON-able view of this supervisor's health for readiness
+        endpoints: per-engine healthy/quarantined (with remaining
+        cool-down seconds) plus the telemetry counters. `degraded` is
+        True when any registered engine is currently quarantined."""
+        quarantined = self.breaker.state()
+        return {
+            "engines": {e: {"healthy": e not in quarantined,
+                            **({"cooldown_s": quarantined[e]}
+                               if e in quarantined else {})}
+                        for e in self.registry},
+            "degraded": bool(quarantined),
+            "telemetry": self.telemetry.snapshot(),
+        }
+
     # -- single supervised call ------------------------------------------
 
     def _sleep_backoff(self, attempt: int) -> None:

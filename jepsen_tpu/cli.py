@@ -23,7 +23,6 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
-import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -395,7 +394,9 @@ def single_test_cmd(
 
 
 def serve_cmd() -> dict:
-    """The `serve` subcommand: web UI over the store (cli.clj:306-321)."""
+    """The `serve` subcommand: web UI over the store (cli.clj:306-321),
+    or — with ``--daemon`` — the resident verdict service (serve/):
+    AOT-warmed engines behind the durable check queue."""
 
     def opt_spec(p):
         p.add_argument("-b", "--host", default="0.0.0.0", help="Bind host")
@@ -404,20 +405,47 @@ def serve_cmd() -> dict:
             "--store-dir", default=None, metavar="DIR",
             help="Root directory for test results (default ./store)",
         )
+        p.add_argument(
+            "--daemon", action="store_true",
+            help="Run the resident verdict daemon (submit/verdict/stream "
+            "API) instead of the web UI",
+        )
+        p.add_argument(
+            "--queue-dir", default=None, metavar="DIR",
+            help="[daemon] Durable queue directory "
+            "(default <store-dir>/serve-queue)",
+        )
+        p.add_argument(
+            "--bundle-dir", default=None, metavar="DIR",
+            help="[daemon] AOT engine bundle directory; 'off' disables "
+            "(default ~/.cache/jepsen-tpu/bundle)",
+        )
+        p.add_argument(
+            "--max-pending", type=int, default=None, metavar="N",
+            help="[daemon] Admission bound: reject submissions past N "
+            "pending jobs (HTTP 429 + Retry-After)",
+        )
 
     def run(opts):
         from . import web
+
+        if opts.get("daemon"):
+            from .serve.daemon import run_daemon
+
+            return run_daemon(opts)
+        # Preimport before the socket goes up: serve_until_signal's
+        # first `from .core import DrainSignal` drags in jax, and a
+        # SIGTERM arriving during those seconds would hit the default
+        # disposition instead of the drain handler.
+        from .core import DrainSignal  # noqa: F401
 
         server = web.serve(
             host=opts["host"], port=opts["port"], store_dir=opts.get("store_dir")
         )
         log.info("Listening on http://%s:%s/", opts["host"], server.server_port)
-        try:
-            while True:
-                time.sleep(1)
-        except KeyboardInterrupt:
-            server.shutdown()
-        return 0
+        # SIGTERM drains and exits 143 so process managers see a clean
+        # signal-shaped stop; ctrl-C still exits 0
+        return web.serve_until_signal(server)
 
     return {"serve": Subcommand(run=run, opt_spec=opt_spec)}
 

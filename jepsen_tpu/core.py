@@ -634,23 +634,104 @@ def snarf_logs(test) -> None:
     real_pmap(snarf, test["nodes"])
 
 
+class DrainSignal:
+    """The PR-5 preemption-drain contract as a reusable primitive:
+    the FIRST SIGTERM invokes `on_drain` (which returns True when a
+    graceful drain was actually initiated) and the process winds down
+    through its normal cleanup; a second SIGTERM — or a first one that
+    couldn't start a drain — raises SystemExit(143) so finally blocks
+    still fire and containerized runs exit with the conventional
+    128+SIGTERM status. Shared by the test-run hook below and the
+    resident verdict daemon (jepsen_tpu/serve), whose drain closes the
+    admission gate and finishes in-flight verdicts instead of closing
+    a generator gate.
+
+    Handlers only install from the main thread (signal module rule);
+    elsewhere install() is a no-op and SIGTERM keeps its prior
+    disposition."""
+
+    def __init__(self, on_drain, what: str = "run"):
+        self.on_drain = on_drain
+        self.what = what
+        self.draining = threading.Event()
+        self._prev = None
+        self._installed = False
+
+    def _on_term(self, signum, frame):
+        if not self.draining.is_set():
+            initiated = False
+            try:
+                initiated = bool(self.on_drain())
+            except Exception:  # noqa: BLE001 — a broken drain hook
+                #               must not swallow the terminate request
+                log.warning("drain hook failed", exc_info=True)
+            if initiated:
+                log.warning("SIGTERM: draining %s (send SIGTERM again "
+                            "to force exit)", self.what)
+                self.draining.set()
+                return
+        raise SystemExit(143)
+
+    def install(self) -> "DrainSignal":
+        import signal
+
+        if threading.current_thread() is threading.main_thread():
+            try:
+                self._prev = signal.signal(signal.SIGTERM, self._on_term)
+                self._installed = True
+            except ValueError:
+                self._prev = None
+        return self
+
+    def uninstall(self) -> None:
+        import signal
+
+        if self._installed:
+            try:
+                signal.signal(signal.SIGTERM, self._prev)
+            except ValueError:
+                pass
+            self._installed = False
+
+    def __enter__(self) -> "DrainSignal":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
 class _SnarfHook:
     """Crash-time log collection (core.clj:132-149): the reference
     installs a JVM shutdown hook so DB logs still download on ctrl-C.
     Python's finally blocks already run on KeyboardInterrupt, but a
     SIGTERM kills the process without unwinding and a crash *during*
     cleanup can skip the snarf — so while a test runs we (a) turn the
-    FIRST SIGTERM into a graceful preemption drain (close the
-    generator gate and let the run wind down, checkpointed and
-    resumable) with a second SIGTERM forcing SystemExit so finally
-    blocks still fire, and (b) register an atexit backstop. snarf-once
-    semantics keep the normal path from downloading twice."""
+    FIRST SIGTERM into a graceful preemption drain via DrainSignal
+    (close the generator gate and let the run wind down, checkpointed
+    and resumable; a second SIGTERM forces SystemExit so finally
+    blocks still fire), and (b) register an atexit backstop.
+    snarf-once semantics keep the normal path from downloading
+    twice."""
 
     def __init__(self, test):
         self.test = test
         self._done = False
         self._lock = threading.Lock()
-        self._prev_sigterm = None
+        self._drain_signal = DrainSignal(self._start_drain, what="run")
+
+    def _start_drain(self) -> bool:
+        # graceful preemption drain (TPU maintenance sends SIGTERM):
+        # close the generator gate — workers drain in-flight invokes
+        # through the normal timeout/:info path, teardown heals active
+        # faults, and run_case flushes the WAL and writes a final
+        # checkpoint. Without a drain gate there is nothing to drain.
+        drain = self.test.get("_drain")
+        if drain is None or drain.is_set():
+            return False
+        self.test["_preempted"] = True
+        drain.set()
+        return True
 
     def snarf_once(self) -> None:
         with self._lock:
@@ -664,42 +745,16 @@ class _SnarfHook:
 
     def __enter__(self):
         import atexit
-        import signal
-
-        def on_term(signum, frame):
-            drain = self.test.get("_drain")
-            if drain is not None and not drain.is_set():
-                # graceful preemption drain (TPU maintenance sends
-                # SIGTERM): close the generator gate — workers drain
-                # in-flight invokes through the normal timeout/:info
-                # path, teardown heals active faults, and run_case
-                # flushes the WAL and writes a final checkpoint. A
-                # second SIGTERM forces the old immediate exit.
-                log.warning("SIGTERM: draining run for preemption "
-                            "(send SIGTERM again to force exit)")
-                self.test["_preempted"] = True
-                drain.set()
-                return
-            raise SystemExit(143)
 
         atexit.register(self.snarf_once)
-        if threading.current_thread() is threading.main_thread():
-            try:
-                self._prev_sigterm = signal.signal(signal.SIGTERM, on_term)
-            except ValueError:
-                self._prev_sigterm = None
+        self._drain_signal.install()
         return self
 
     def __exit__(self, *exc):
         import atexit
-        import signal
 
         atexit.unregister(self.snarf_once)
-        if self._prev_sigterm is not None:
-            try:
-                signal.signal(signal.SIGTERM, self._prev_sigterm)
-            except ValueError:
-                pass
+        self._drain_signal.uninstall()
         return False
 
 

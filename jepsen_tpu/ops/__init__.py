@@ -17,28 +17,50 @@ import. Override the location with JEPSEN_TPU_COMPILE_CACHE (set to
 import os as _os
 
 
-def _configure_compilation_cache() -> None:
+def configure_compilation_cache(path=None, force=False):
+    """Point JAX's persistent compilation cache somewhere useful.
+
+    With no arguments this is the import-time default wiring: our env
+    var > the standard JAX env var (this jax version does not read it
+    itself, so apply the user's value for them) > a dir the
+    application configured before import > the per-user default.  An
+    explicit ``path`` (the AOT engine bundle pins the cache inside the
+    bundle directory so warm starts hit exactly the compiles the
+    bundle stamped) takes precedence over everything when ``force`` is
+    set, and over everything but an operator env var otherwise.
+    Returns the directory in effect, or None when caching is off or
+    jax is unavailable."""
     ours = _os.environ.get("JEPSEN_TPU_COMPILE_CACHE")
-    # precedence: our env var > the standard JAX env var (this jax
-    # version does not read it itself, so apply the user's value for
-    # them) > a dir the application configured before import > default
-    path = ours or _os.environ.get("JAX_COMPILATION_CACHE_DIR") \
-        or _os.path.join(
-            _os.path.expanduser("~"), ".cache", "jepsen-tpu", "xla-cache")
-    if path.lower() in ("", "0", "off", "none"):
-        return
+    if force and path:
+        chosen = path
+    else:
+        chosen = ours or path \
+            or _os.environ.get("JAX_COMPILATION_CACHE_DIR") \
+            or _os.path.join(
+                _os.path.expanduser("~"), ".cache", "jepsen-tpu",
+                "xla-cache")
+    if str(chosen).lower() in ("", "0", "off", "none"):
+        return None
     try:
         import jax
 
-        if (ours is None
+        if (not force and path is None and ours is None
                 and _os.environ.get("JAX_COMPILATION_CACHE_DIR") is None
                 and jax.config.jax_compilation_cache_dir):
-            return  # application already configured a cache dir
-        jax.config.update("jax_compilation_cache_dir", path)
+            # application already configured a cache dir
+            return jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", str(chosen))
         # search kernels recompile per shape bucket; even small entries
         # are worth keeping, and ~0.5s is well under a kernel compile
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.5)
+        return str(chosen)
     except Exception:  # noqa: BLE001 — older jax or read-only home
-        pass
+        return None
+
+
+def _configure_compilation_cache() -> None:
+    """Import-time hook the kernel modules call (kept under the
+    historical private name so their import sites stay unchanged)."""
+    configure_compilation_cache()

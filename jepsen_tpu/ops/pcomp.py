@@ -95,3 +95,18 @@ def split(model, es: Entries) -> list | None:
     if comps is None:
         return None
     return [(m, _subset(es, idx, rewrite)) for m, idx, rewrite in comps]
+
+
+def group_lanes(comp_lanes) -> dict:
+    """{sub_model: [indices]} over a flat list of (sub_model, Entries)
+    lanes. The batch engines take ONE model per call, so every consumer
+    of flattened decompositions — Linearizable._component_results for
+    one check, the resident daemon's cross-run packer for many — buckets
+    lanes per distinct sub-model before dispatch. Queue components
+    share one UnorderedQueue; a multi-register split yields one
+    Register per distinct initial value (usually just one). Insertion
+    order is preserved so dispatch order is deterministic."""
+    groups: dict = {}
+    for i, (m, _es) in enumerate(comp_lanes):
+        groups.setdefault(m, []).append(i)
+    return groups

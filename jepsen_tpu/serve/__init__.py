@@ -1,0 +1,34 @@
+"""Resident verdict service: AOT-warmed engines behind a crash-safe,
+backpressured check queue.
+
+Every one-shot CLI run pays compile + calibration + arena setup per
+invocation — fatal for short histories (cold compile regressed 3.3s →
+8.8s as engines multiplied, ROADMAP item 1). This package keeps the
+engines resident instead:
+
+bundle.py    the AOT engine bundle: a version-stamped manifest
+             (jax/backend/code digests) co-located with a pinned JAX
+             persistent-compile-cache directory plus the persisted
+             Calibration, so a warm daemon start skips both the
+             multi-second compiles and the crossover re-measurement.
+             A stale fingerprint rebuilds — never a wrong verdict.
+registry.py  the session-scoped engine registry: one process-wide set
+             of supervisors, breakers, arenas, and workload checkers
+             shared across every queued request, with a combined
+             health snapshot for the readiness endpoint.
+queue.py     the durable work queue: job specs and verdicts as
+             atomically-renamed JSON files (the store write-temp →
+             fsync → rename discipline), so a SIGKILL'd daemon
+             restarts with no lost and no double-verdicted work;
+             weighted round-robin fairness across clients; bounded
+             admission (reject-with-retry-after, not OOM).
+daemon.py    the HTTP front end (`jepsen-tpu serve --daemon`):
+             submit/verdict/stream endpoints, health/readiness wired
+             to breaker and HBM state, cross-run batch packing of
+             independent-key lanes (independent.pack_check), and
+             SIGTERM graceful drain reusing core.DrainSignal.
+"""
+
+from .bundle import EngineBundle  # noqa: F401
+from .queue import DurableQueue, QueueFull  # noqa: F401
+from .registry import EngineRegistry  # noqa: F401

@@ -1,0 +1,339 @@
+"""The resident verdict daemon: HTTP front end + check worker.
+
+``jepsen-tpu serve --daemon`` mounts this beside the web-UI serve
+subcommand. The daemon owns one EngineRegistry (warmed through the
+AOT bundle), one DurableQueue, and one worker thread that drains the
+queue in weighted-round-robin batches:
+
+* jobs of a **packable** workload (independent-key histories) are
+  cross-run batch packed — MANY clients' histories flatten into ONE
+  batched engine pass via ``independent.pack_check``, which
+  P-compositionality licenses (each key lane's verdict is independent
+  of which run it arrived with) and the measured-crossover router
+  prices (pooled lanes clear the pallas bar sooner than any one run's
+  would);
+* other workloads check per job through ``checker.check_safe``.
+
+Endpoints (stdlib ThreadingHTTPServer, the web.py idiom)::
+
+    POST /submit            {client, workload, history, weight?} -> {id}
+                            429 + Retry-After when the queue is full,
+                            503 + Retry-After while draining
+    GET  /verdict/<id>      the committed verdict; 202 while pending
+                            (?wait=SECONDS long-polls)
+    GET  /stream            JSONL of verdicts as they commit
+    GET  /healthz           liveness (200 while the process serves)
+    GET  /readyz            readiness: breaker + HBM + bundle state;
+                            503 while draining
+    GET  /stats             queue depth, per-client backlog, telemetry
+
+SIGTERM drains via core.DrainSignal (the PR-5 machinery): the first
+signal closes admission (submits get 503), lets the worker finish and
+commit its in-flight batch — unanswered specs stay durable for the
+next start — and exits 143; a second SIGTERM force-exits.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from .. import store
+from ..checker import check_safe
+from ..history import index as index_history, Op
+
+log = logging.getLogger("jepsen_tpu.serve.daemon")
+
+#: worker pacing knobs (env so the chaos driver can widen the window
+#: between batches without patching code)
+BATCH_MAX_ENV = "JEPSEN_TPU_SERVE_BATCH_MAX"
+PACE_ENV = "JEPSEN_TPU_SERVE_PACE_S"
+
+
+def _jsonable(v):
+    """Verdicts normalized exactly as store.write_json persists them
+    (results.json round trip), so a daemon verdict compares bit-for-
+    bit against a one-shot run's stored results."""
+    return json.loads(json.dumps(store._json_keys(v),
+                                 default=store._json_default))
+
+
+class VerdictDaemon:
+    """Queue + registry + the single check worker."""
+
+    def __init__(self, queue, registry, batch_max: int = 64,
+                 pace_s: float = 0.0):
+        self.queue = queue
+        self.registry = registry
+        self.batch_max = int(
+            os.environ.get(BATCH_MAX_ENV) or batch_max)
+        self.pace_s = float(os.environ.get(PACE_ENV) or pace_s)
+        self.draining = threading.Event()
+        self.ready = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, name="serve verdict worker", daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._worker.start()
+
+    def drain(self) -> bool:
+        """First-SIGTERM hook: close admission, let the in-flight
+        batch commit, stop. Always initiates (returns True)."""
+        self.draining.set()
+        with self.queue._cv:
+            self.queue._cv.notify_all()
+        return True
+
+    def join(self, timeout: float | None = None) -> None:
+        self._worker.join(timeout)
+
+    # -- the check loop ----------------------------------------------------
+
+    def _rehydrate(self, spec) -> list:
+        wl = self.registry.workload(spec["workload"])
+        ops = [Op.from_dict(d) for d in spec["history"]]
+        if wl["rehydrate"] is not None:
+            ops = [wl["rehydrate"](o) for o in ops]
+        return index_history(ops)
+
+    def _check_group(self, workload: str, specs: list) -> list:
+        """Verdicts for one workload's batch of specs, aligned. The
+        test stub carries no start_time, so checkers write no
+        artifacts — the verdict file is the daemon's artifact."""
+        wl = self.registry.workload(workload)
+        test = {"name": f"serve-{workload}"}
+        histories = [self._rehydrate(s) for s in specs]
+        if wl.get("packable") and len(histories) > 1:
+            from .. import independent
+
+            return independent.pack_check(wl["checker"], test, histories)
+        return [check_safe(wl["checker"], test, h) for h in histories]
+
+    def _run(self) -> None:
+        self.ready.set()
+        while True:
+            if not self.queue.wait_for_work(timeout=0.5):
+                if self.draining.is_set():
+                    return
+                continue
+            batch = self.queue.take_batch(self.batch_max)
+            if not batch:
+                continue
+            by_workload: dict = {}
+            for spec in batch:
+                by_workload.setdefault(spec["workload"], []).append(spec)
+            for workload, specs in by_workload.items():
+                try:
+                    verdicts = self._check_group(workload, specs)
+                except Exception:  # noqa: BLE001 — a broken workload
+                    #               must not wedge the whole queue
+                    log.exception("workload %s batch failed", workload)
+                    verdicts = [{"valid": "unknown",
+                                 "error": f"workload {workload} failed"}
+                                for _ in specs]
+                for spec, verdict in zip(specs, verdicts):
+                    self.queue.commit(spec["id"], _jsonable(verdict))
+            if self.pace_s:
+                time.sleep(self.pace_s)
+            if self.draining.is_set():
+                # in-flight work committed; leftover specs stay
+                # durable for the next start
+                return
+
+
+class _Handler(BaseHTTPRequestHandler):
+    daemon_obj: VerdictDaemon = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send_json(self, code: int, payload, extra_headers=()) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- POST /submit ------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802
+        try:
+            self._post()
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("error serving %s", self.path)
+            self._send_json(500, {"error": "internal error"})
+
+    def _post(self):
+        d = self.daemon_obj
+        path = urlparse(self.path).path
+        if path != "/submit":
+            return self._send_json(404, {"error": "not found"})
+        if d.draining.is_set():
+            return self._send_json(
+                503, {"error": "draining",
+                      "retry_after_s": d.queue.retry_after_s},
+                [("Retry-After", str(int(d.queue.retry_after_s) or 1))])
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            spec = json.loads(self.rfile.read(n))
+            client = str(spec["client"])
+            workload = str(spec["workload"])
+            history = spec["history"]
+            weight = int(spec.get("weight", 1))
+            assert isinstance(history, list)
+        except Exception:  # noqa: BLE001 — malformed submission
+            return self._send_json(400, {"error": "bad submission"})
+        try:
+            d.registry.workload(workload)
+        except KeyError:
+            return self._send_json(
+                400, {"error": f"unknown workload {workload!r}",
+                      "workloads": d.registry.known_workloads()})
+        from .queue import QueueFull
+
+        try:
+            job_id = d.queue.submit(client, workload, history,
+                                    weight=weight)
+        except QueueFull as e:
+            # bounded-queue backpressure: reject with a retry hint
+            # rather than buffering toward OOM
+            return self._send_json(
+                429, {"error": "queue full", "pending": e.pending,
+                      "retry_after_s": e.retry_after_s},
+                [("Retry-After", str(int(e.retry_after_s) or 1))])
+        return self._send_json(200, {"id": job_id})
+
+    # -- GETs --------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        try:
+            self._get()
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("error serving %s", self.path)
+            self._send_json(500, {"error": "internal error"})
+
+    def _get(self):
+        d = self.daemon_obj
+        url = urlparse(self.path)
+        path = url.path
+        if path == "/healthz":
+            return self._send_json(200, {"ok": True})
+        if path == "/readyz":
+            health = d.registry.health()
+            health["draining"] = d.draining.is_set()
+            code = 503 if (d.draining.is_set()
+                           or not d.ready.is_set()) else 200
+            return self._send_json(code, health)
+        if path == "/stats":
+            stats = d.queue.stats()
+            stats["draining"] = d.draining.is_set()
+            stats["supervision"] = \
+                d.registry.supervisor.telemetry.snapshot()
+            return self._send_json(200, stats)
+        if path.startswith("/verdict/"):
+            job_id = unquote(path[len("/verdict/"):])
+            q = parse_qs(url.query)
+            wait = float(q.get("wait", ["0"])[0])
+            try:
+                v = (d.queue.wait_for_verdict(job_id, timeout=wait)
+                     if wait > 0 else d.queue.verdict(job_id))
+            except KeyError:
+                return self._send_json(404, {"error": "unknown job"})
+            if v is None:
+                return self._send_json(202, {"id": job_id,
+                                             "state": "pending"})
+            return self._send_json(200, {"id": job_id, "verdict": v})
+        if path == "/stream":
+            return self._stream()
+        return self._send_json(404, {"error": "not found"})
+
+    def _stream(self):
+        """Stream verdicts as they commit, one JSON object per line,
+        until the daemon drains (or the client hangs up). Starts from
+        the already-committed set so a reconnecting client misses
+        nothing."""
+        d = self.daemon_obj
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        known: set = set()
+        while True:
+            fresh = d.queue.wait_for_commit_after(known, timeout=0.5)
+            for jid in fresh:
+                known.add(jid)
+                rec = {"id": jid, "verdict": d.queue.verdict(jid)}
+                self.wfile.write(json.dumps(rec).encode() + b"\n")
+            self.wfile.flush()
+            if not fresh and d.draining.is_set():
+                return
+
+
+def serve(queue, registry, host="127.0.0.1", port=0,
+          batch_max: int = 64,
+          pace_s: float = 0.0) -> tuple:
+    """Start the daemon: worker + HTTP server (daemon threads).
+    Returns (server, daemon); bound port at server.server_port."""
+    daemon = VerdictDaemon(queue, registry, batch_max=batch_max,
+                           pace_s=pace_s)
+    handler = type("Handler", (_Handler,), {"daemon_obj": daemon})
+    server = ThreadingHTTPServer((host, port), handler)
+    daemon.start()
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="serve http")
+    t.start()
+    return server, daemon
+
+
+def run_daemon(opts: dict) -> int:
+    """The `serve --daemon` body: warm the bundle, recover the queue,
+    serve until SIGTERM, drain, exit 143 (or 0 on ctrl-C)."""
+    from .. import web
+    from .bundle import EngineBundle
+    from .queue import DEFAULT_MAX_PENDING, DurableQueue
+    from .registry import EngineRegistry
+
+    queue_dir = opts.get("queue_dir") or os.path.join(
+        opts.get("store_dir") or store.BASE_DIR, "serve-queue")
+    bundle_dir = opts.get("bundle_dir")
+    bundle = None
+    if (bundle_dir or "").lower() not in ("off", "none", "0"):
+        bundle = EngineBundle(bundle_dir or os.path.join(
+            os.path.expanduser("~"), ".cache", "jepsen-tpu", "bundle"))
+    registry = EngineRegistry(bundle)
+    state = registry.warm()
+    if state:
+        log.info("engine bundle %s in %.2fs",
+                 "warm" if state.get("warm") else "built",
+                 state.get("elapsed_s") or 0.0)
+    queue = DurableQueue(
+        queue_dir,
+        max_pending=int(opts.get("max_pending") or DEFAULT_MAX_PENDING))
+    server, daemon = serve(
+        queue, registry, host=opts.get("host") or "127.0.0.1",
+        port=int(opts.get("port") or 8181))
+    log.info("verdict daemon on http://%s:%s/ (queue at %s)",
+             opts.get("host") or "127.0.0.1", server.server_port,
+             queue_dir)
+    code = web.serve_until_signal(server, on_drain=daemon.drain,
+                                  what="verdict daemon")
+    # the drain hook closed admission; give the worker a bounded
+    # window to commit its in-flight batch before the process exits
+    daemon.draining.set()
+    daemon.join(timeout=60)
+    return code

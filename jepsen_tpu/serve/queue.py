@@ -1,0 +1,257 @@
+"""The durable work queue: exactly-once verdicts across SIGKILL.
+
+Layout under one queue directory::
+
+    jobs/<id>.json        the job spec (client, workload, history, seq)
+    verdicts/<id>.json    the committed verdict
+
+Both sides are written with the store module's write-temp → fsync →
+rename discipline (``store.atomic_write_json``), so a kill at any
+instant leaves each file either absent or complete — never torn. The
+**verdict file is the commit point**: a job is done iff its verdict
+file exists. A daemon SIGKILL'd mid-check restarts, rescans ``jobs/``,
+finds the spec still unanswered, and re-runs it — re-running is safe
+because checking is pure (same history, same verdict bits) and the
+single atomic verdict write means the client can never observe two
+answers. Nothing is ever lost (the spec was durable before submit
+acknowledged) and nothing is double-verdicted (one file, one rename).
+
+Admission control: ``max_pending`` bounds the backlog; past it,
+``submit`` raises ``QueueFull`` carrying a retry-after hint instead of
+buffering toward OOM — the daemon maps it to HTTP 429.
+
+Fairness: ``take_batch`` drains clients weighted-round-robin — each
+round, every client with waiting jobs contributes up to its weight in
+submission order — so one chatty client cannot starve the rest, while
+a client that paid for weight w gets w shares of every round.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from .. import store
+
+log = logging.getLogger("jepsen_tpu.serve.queue")
+
+JOBS_DIR = "jobs"
+VERDICTS_DIR = "verdicts"
+
+DEFAULT_MAX_PENDING = 256
+DEFAULT_RETRY_AFTER_S = 5.0
+
+
+class QueueFull(Exception):
+    """Admission refused: the backlog is at max_pending."""
+
+    def __init__(self, pending: int, retry_after_s: float):
+        super().__init__(
+            f"queue full ({pending} pending); retry in {retry_after_s}s")
+        self.pending = pending
+        self.retry_after_s = retry_after_s
+
+
+class DurableQueue:
+    def __init__(self, root: str, max_pending: int = DEFAULT_MAX_PENDING,
+                 retry_after_s: float = DEFAULT_RETRY_AFTER_S):
+        self.root = os.path.abspath(root)
+        self.max_pending = max_pending
+        self.retry_after_s = retry_after_s
+        self._jobs_dir = os.path.join(self.root, JOBS_DIR)
+        self._verdicts_dir = os.path.join(self.root, VERDICTS_DIR)
+        os.makedirs(self._jobs_dir, exist_ok=True)
+        os.makedirs(self._verdicts_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # crash recovery is just a directory scan: specs without
+        # verdicts are the backlog, in submission (seq) order
+        self._jobs: dict = {}      # id -> spec dict
+        self._done: set = set()    # ids with committed verdicts
+        self._seq = 0
+        self._recover()
+
+    # -- recovery ----------------------------------------------------------
+
+    @staticmethod
+    def _read_json(p: str):
+        try:
+            with open(p) as f:
+                v = json.load(f)
+            return v if isinstance(v, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _recover(self) -> None:
+        """Rebuild in-memory state from the directories. ``.tmp``
+        leftovers from a mid-rename kill are ignored (and later
+        overwritten); an unparseable spec is quarantined by skipping —
+        atomic writes should make that impossible, but a disk that
+        lies must not wedge the daemon."""
+        for fn in os.listdir(self._verdicts_dir):
+            if fn.endswith(".json"):
+                self._done.add(fn[:-5])
+        n_stale = 0
+        for fn in sorted(os.listdir(self._jobs_dir)):
+            if not fn.endswith(".json"):
+                continue
+            spec = self._read_json(os.path.join(self._jobs_dir, fn))
+            if spec is None or "id" not in spec:
+                log.warning("queue recovery: skipping unreadable %s", fn)
+                continue
+            self._jobs[spec["id"]] = spec
+            self._seq = max(self._seq, int(spec.get("seq", 0)) + 1)
+            if spec["id"] not in self._done:
+                n_stale += 1
+        if n_stale:
+            log.info("queue recovery: %d unanswered job(s) re-enqueued",
+                     n_stale)
+
+    # -- submission --------------------------------------------------------
+
+    def pending_ids(self) -> list:
+        with self._lock:
+            return self._pending_ids_locked()
+
+    def _pending_ids_locked(self) -> list:
+        return sorted((j["id"] for j in self._jobs.values()
+                       if j["id"] not in self._done),
+                      key=lambda i: self._jobs[i].get("seq", 0))
+
+    def submit(self, client: str, workload: str, history: list,
+               weight: int = 1) -> str:
+        """Durably enqueue one history. The spec hits disk (fsync'd)
+        BEFORE the id is returned, so an acknowledged submission
+        survives any kill. Raises QueueFull past max_pending."""
+        with self._lock:
+            pending = len(self._pending_ids_locked())
+            if pending >= self.max_pending:
+                raise QueueFull(pending, self.retry_after_s)
+            seq = self._seq
+            self._seq += 1
+            job_id = f"{seq:08d}-{client}"
+            spec = {"id": job_id, "seq": seq, "client": str(client),
+                    "workload": str(workload),
+                    "weight": max(1, int(weight)),
+                    "history": list(history)}
+            store.atomic_write_json(
+                os.path.join(self._jobs_dir, job_id + ".json"), spec)
+            self._jobs[job_id] = spec
+            self._cv.notify_all()
+        return job_id
+
+    # -- scheduling --------------------------------------------------------
+
+    def take_batch(self, max_jobs: int = 64) -> list:
+        """Up to max_jobs pending specs, weighted round-robin across
+        clients: rounds visit every client with waiting jobs (sorted
+        for determinism) and take up to `weight` jobs each, oldest
+        first. Jobs stay pending until commit() — a crash between
+        take and commit re-runs them."""
+        with self._lock:
+            by_client: dict = {}
+            for jid in self._pending_ids_locked():
+                by_client.setdefault(
+                    self._jobs[jid]["client"], []).append(jid)
+            out: list = []
+            while by_client and len(out) < max_jobs:
+                for client in sorted(by_client):
+                    lane = by_client.get(client)
+                    if not lane:
+                        by_client.pop(client, None)
+                        continue
+                    w = self._jobs[lane[0]].get("weight", 1)
+                    for _ in range(max(1, int(w))):
+                        if not lane or len(out) >= max_jobs:
+                            break
+                        out.append(self._jobs[lane.pop(0)])
+                    if not lane:
+                        by_client.pop(client, None)
+            return out
+
+    def wait_for_work(self, timeout: float | None = None) -> bool:
+        """Block until at least one job is pending (or timeout)."""
+        with self._lock:
+            if self._pending_ids_locked():
+                return True
+            self._cv.wait(timeout)
+            return bool(self._pending_ids_locked())
+
+    # -- commit / read-back ------------------------------------------------
+
+    def commit(self, job_id: str, verdict) -> None:
+        """Atomically publish the verdict — THE commit point. A
+        duplicate commit (crash replay racing a finished write) is a
+        no-op: the first rename won."""
+        with self._lock:
+            if job_id in self._done:
+                return
+            store.atomic_write_json(
+                os.path.join(self._verdicts_dir, job_id + ".json"),
+                {"id": job_id, "verdict": verdict})
+            self._done.add(job_id)
+            self._cv.notify_all()
+
+    def verdict(self, job_id: str):
+        """The committed verdict dict, or None while pending. Unknown
+        ids raise KeyError."""
+        with self._lock:
+            known = job_id in self._jobs
+        if not known:
+            # a verdict may outlive its spec in a pruned queue; check
+            # disk before declaring the id unknown
+            rec = self._read_json(
+                os.path.join(self._verdicts_dir, job_id + ".json"))
+            if rec is None:
+                raise KeyError(job_id)
+            return rec.get("verdict")
+        rec = self._read_json(
+            os.path.join(self._verdicts_dir, job_id + ".json"))
+        return None if rec is None else rec.get("verdict")
+
+    def wait_for_verdict(self, job_id: str, timeout: float | None = None):
+        """Long-poll one verdict; None on timeout."""
+        import time as _t
+
+        deadline = None if timeout is None else _t.monotonic() + timeout
+        with self._lock:
+            while job_id not in self._done:
+                if job_id not in self._jobs:
+                    raise KeyError(job_id)
+                remaining = (None if deadline is None
+                             else deadline - _t.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+        return self.verdict(job_id)
+
+    def wait_for_commit_after(self, known: set,
+                              timeout: float | None = None) -> list:
+        """Ids committed that aren't in `known` — the verdict-stream
+        endpoint's tail-follow primitive."""
+        import time as _t
+
+        deadline = None if timeout is None else _t.monotonic() + timeout
+        with self._lock:
+            while True:
+                fresh = sorted(self._done - known)
+                if fresh:
+                    return fresh
+                remaining = (None if deadline is None
+                             else deadline - _t.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return []
+                self._cv.wait(remaining)
+
+    def stats(self) -> dict:
+        with self._lock:
+            pending = self._pending_ids_locked()
+            per_client: dict = {}
+            for jid in pending:
+                c = self._jobs[jid]["client"]
+                per_client[c] = per_client.get(c, 0) + 1
+            return {"pending": len(pending), "done": len(self._done),
+                    "max_pending": self.max_pending,
+                    "pending_per_client": per_client}

@@ -1,0 +1,158 @@
+"""The session-scoped engine registry.
+
+Before the resident service, every ``core.run``/``Linearizable.check``
+reached the process-wide supervisors through ``checker.supervisor``'s
+singletons implicitly, and nothing owned the set as a unit. The
+registry lifts that ownership to a session object the daemon holds for
+its whole life: ONE search supervisor, ONE closure supervisor (their
+circuit breakers, telemetry, and the pallas ``_HostArena`` pool keep
+state across requests — two clients hitting a quarantined engine both
+ride the demoted rung instead of re-tripping it), the active AOT
+bundle, and the workload table that maps submitted job specs to
+checker instances.
+
+The registry deliberately DELEGATES to the ``checker.supervisor``
+singletons rather than building private Supervisors: breaker state
+must be shared with any in-process one-shot check (and with
+calibration's health gate), and those all route through ``get()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+log = logging.getLogger("jepsen_tpu.serve.registry")
+
+
+def _register_workload() -> dict:
+    """Keyed CAS-register histories: the independent checker over the
+    linearizable search — the exact checker a one-shot
+    `independent.checker(linearizable(CASRegister()))` run builds, so
+    daemon verdicts and CLI verdicts are the same computation."""
+    from ..checker import linearizable
+    from ..independent import checker as indep_checker, tuple_
+    from ..models import CASRegister
+
+    def rehydrate(op):
+        # HTTP submissions arrive as JSON: KVTuple values flattened to
+        # [k, v] lists. Client ops of this workload are ALWAYS keyed,
+        # so any 2-element list value on a client op rebuilds the
+        # tuple; nemesis/info ops pass through.
+        v = op.value
+        if (op.process != "nemesis" and isinstance(v, (list, tuple))
+                and len(v) == 2):
+            return op.with_(value=tuple_(v[0], v[1]))
+        return op
+
+    return {"checker": indep_checker(linearizable(CASRegister(None))),
+            "rehydrate": rehydrate,
+            "packable": True}
+
+
+def _cycle_workload() -> dict:
+    """Transactional list-append histories for the cycle checker; txn
+    values are JSON-native nested lists and need no rehydration."""
+    from ..checker import cycle
+
+    return {"checker": cycle.checker(),
+            "rehydrate": None,
+            "packable": False}
+
+
+#: workload name -> spec factory; a job spec's "workload" field picks
+#: one. Factories run lazily so importing serve/ stays jax-free.
+WORKLOAD_FACTORIES = {
+    "register": _register_workload,
+    "cycle": _cycle_workload,
+}
+
+
+class EngineRegistry:
+    """One session's shared engines + workloads + bundle state."""
+
+    def __init__(self, bundle=None):
+        self.bundle = bundle           # serve.bundle.EngineBundle | None
+        self.bundle_state: dict = {}   # EngineBundle.ensure() result
+        self._workloads: dict = {}
+        self._lock = threading.Lock()
+
+    # -- engines (the process-wide supervisors) ---------------------------
+
+    @property
+    def supervisor(self):
+        from ..checker import supervisor as sup_mod
+
+        return sup_mod.get()
+
+    @property
+    def closure_supervisor(self):
+        from ..checker import supervisor as sup_mod
+
+        return sup_mod.get_closure()
+
+    # -- bundle ------------------------------------------------------------
+
+    def warm(self) -> dict:
+        """Activate + warm the bundle (no-op without one). Returns the
+        ensure() result; ``elapsed_s`` is this start's cold_compile_s."""
+        if self.bundle is not None:
+            self.bundle_state = self.bundle.ensure()
+        return self.bundle_state
+
+    # -- workloads ---------------------------------------------------------
+
+    def workload(self, name: str) -> dict:
+        """The (cached) workload spec for a job's workload name."""
+        with self._lock:
+            spec = self._workloads.get(name)
+            if spec is None:
+                factory = WORKLOAD_FACTORIES.get(name)
+                if factory is None:
+                    raise KeyError(f"unknown workload {name!r}")
+                spec = factory()
+                self._workloads[name] = spec
+            return spec
+
+    def known_workloads(self) -> list:
+        return sorted(WORKLOAD_FACTORIES)
+
+    # -- health ------------------------------------------------------------
+
+    @staticmethod
+    def _hbm_state() -> dict | None:
+        """Device memory stats when the backend exposes them (TPU HBM;
+        CPU backends usually return None) — surfaced on /readyz so
+        orchestrators can rotate a daemon whose HBM is fragmenting."""
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            stats = dev.memory_stats()
+            if not stats:
+                return None
+            out = {k: int(v) for k, v in stats.items()
+                   if k in ("bytes_in_use", "bytes_limit",
+                            "peak_bytes_in_use", "largest_free_block_bytes")}
+            return out or None
+        except Exception:  # noqa: BLE001 — stats are optional
+            return None
+
+    def health(self) -> dict:
+        """The combined readiness picture: both supervisors'
+        per-engine breaker state + telemetry, bundle warmth, HBM."""
+        out = {
+            "search": self.supervisor.health_snapshot(),
+            "closure": self.closure_supervisor.health_snapshot(),
+            "bundle": {
+                "present": self.bundle is not None,
+                "warm": bool(self.bundle_state.get("warm")),
+                "elapsed_s": self.bundle_state.get("elapsed_s"),
+            },
+        }
+        hbm = self._hbm_state()
+        if hbm:
+            out["hbm"] = hbm
+        out["degraded"] = bool(out["search"]["degraded"]
+                               or out["closure"]["degraded"])
+        return out

@@ -120,6 +120,26 @@ def path_(test, *args) -> str:
 # ---------------------------------------------------------------------------
 # Writers
 
+def atomic_write_json(p: str, value, rotate_prev: bool = False) -> str:
+    """Crash-consistent JSON write: temp → flush+fsync → rename, so a
+    SIGKILL at any instant leaves either the old file or the new one,
+    never a torn half-write. With ``rotate_prev`` the previous current
+    file is rotated to ``.prev`` first (the RunCheckpoint discipline).
+    This is the single write primitive the checkpoint, the serve work
+    queue, and the AOT bundle manifest all share."""
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    tmp = p + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(_json_keys(value), f, default=_json_default)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    if rotate_prev and os.path.exists(p):
+        os.replace(p, p + ".prev")
+    os.replace(tmp, p)
+    return p
+
+
 def _json_keys(v):
     """json's default= hook never applies to dict KEYS — independent-
     checker results are keyed by arbitrary workload keys (e.g. tuples),
@@ -320,17 +340,8 @@ class RunCheckpoint:
         return self._path
 
     def write(self, state: dict) -> str:
-        tmp = self._path + ".tmp"
         with self._lock:
-            with open(tmp, "w") as f:
-                json.dump(_json_keys(state), f, default=_json_default)
-                f.write("\n")
-                f.flush()
-                os.fsync(f.fileno())
-            if os.path.exists(self._path):
-                os.replace(self._path, self._path + ".prev")
-            os.replace(tmp, self._path)
-        return self._path
+            return atomic_write_json(self._path, state, rotate_prev=True)
 
     def load(self) -> dict | None:
         """The newest readable checkpoint, or None when neither the

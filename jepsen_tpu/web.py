@@ -201,3 +201,41 @@ def serve(host="0.0.0.0", port=8080, store_dir=None) -> ThreadingHTTPServer:
     t = threading.Thread(target=server.serve_forever, daemon=True, name="web")
     t.start()
     return server
+
+
+def serve_until_signal(server, on_drain=None, what="web UI",
+                       poll_s: float = 1.0) -> int:
+    """Block until ctrl-C or SIGTERM, then shut `server` down cleanly.
+
+    Returns the exit status the CLI should use: 0 for a ctrl-C, 143
+    (128+SIGTERM) for a terminate — the conventional status container
+    runtimes and TPU preemption agents expect, matching core.run's
+    drain discipline. The first SIGTERM runs `on_drain` (when given)
+    and stops the serve loop; a second SIGTERM force-exits through
+    DrainSignal's SystemExit(143) path."""
+    from .core import DrainSignal
+
+    stop = threading.Event()
+
+    def drain() -> bool:
+        if on_drain is not None:
+            try:
+                on_drain()
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                log.warning("drain hook failed", exc_info=True)
+        stop.set()
+        return True
+
+    sig = DrainSignal(drain, what=what).install()
+    code = 0
+    try:
+        while not stop.is_set():
+            stop.wait(poll_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sig.uninstall()
+        server.shutdown()
+    if sig.draining.is_set():
+        code = 143
+    return code

@@ -1,0 +1,30 @@
+"""Subprocess driver for the serve-daemon chaos e2e
+(tests/test_serve_chaos.py). Runnable as a subprocess:
+
+    python -m tests.serve_driver <queue-dir> <port>
+
+Runs the resident verdict daemon against a test-owned queue directory
+with the AOT bundle disabled (the e2e measures queue durability, not
+compile warmth). The test controls worker pacing through the daemon's
+env knobs (JEPSEN_TPU_SERVE_PACE_S / _BATCH_MAX) so it can SIGKILL the
+process mid-queue deterministically: some verdicts committed, some
+specs still pending. On SIGTERM the daemon drains and exits 143."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from jepsen_tpu.serve.daemon import run_daemon
+
+
+def main(argv) -> int:
+    queue_dir, port = argv[0], int(argv[1])
+    logging.basicConfig(level=logging.INFO,
+                        format="%(name)s %(message)s", stream=sys.stderr)
+    return run_daemon({"queue_dir": queue_dir, "port": port,
+                       "host": "127.0.0.1", "bundle_dir": "off"})
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -94,6 +94,62 @@ class TestFallbackChain:
         assert lin_mod._pallas_batch_min() == cal.batch_min == 1024
 
 
+class TestDiskCache:
+    def test_cache_path_env(self, monkeypatch):
+        monkeypatch.setenv(calibrate._CACHE_ENV, "/some/where.json")
+        assert calibrate.cache_path() == "/some/where.json"
+        for off in ("off", "OFF", "0", "none", ""):
+            monkeypatch.setenv(calibrate._CACHE_ENV, off)
+            assert calibrate.cache_path() is None
+        monkeypatch.delenv(calibrate._CACHE_ENV)
+        assert calibrate.cache_path().endswith("calibration.json")
+
+    def test_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(calibrate._CACHE_ENV,
+                           str(tmp_path / "cal.json"))
+        cal = calibrate.Calibration(0.11, 61e-6, 85e-6)
+        calibrate._save_disk_cache(cal)
+        assert calibrate._load_disk_cache() == cal
+
+    def test_fingerprint_mismatch_is_a_miss(self, tmp_path,
+                                            monkeypatch):
+        """A measurement taken on another backend (or jax build) must
+        not route this one."""
+        import json as _json
+
+        p = tmp_path / "cal.json"
+        monkeypatch.setenv(calibrate._CACHE_ENV, str(p))
+        calibrate._save_disk_cache(calibrate.Calibration(0.11, 1e-6,
+                                                         2e-6))
+        rec = _json.loads(p.read_text())
+        rec["fingerprint"]["device_kind"] = "TPU v9"
+        p.write_text(_json.dumps(rec))
+        assert calibrate._load_disk_cache() is None
+
+    def test_unreadable_cache_is_a_miss(self, tmp_path, monkeypatch):
+        p = tmp_path / "cal.json"
+        p.write_text('{"fingerprint": ')  # torn write
+        monkeypatch.setenv(calibrate._CACHE_ENV, str(p))
+        assert calibrate._load_disk_cache() is None
+
+    def test_disabled_cache_never_touches_disk(self, monkeypatch):
+        monkeypatch.setenv(calibrate._CACHE_ENV, "off")
+        calibrate._save_disk_cache(calibrate.Calibration(0.1, 1e-6,
+                                                         2e-6))
+        assert calibrate._load_disk_cache() is None
+
+    def test_seed_installs_without_measuring(self):
+        """The AOT bundle's warm path: seed() makes the persisted
+        measurement THIS process's calibration — no backend probe, and
+        _reset_for_tests still clears it (in-memory only)."""
+        cal = calibrate.Calibration(0.11, 61e-6, 85e-6)
+        calibrate.seed(cal)
+        assert calibrate.calibration() == cal
+        assert calibrate.batch_min() == cal.batch_min
+        calibrate._reset_for_tests()
+        assert calibrate.calibration() is None  # CPU: no re-measure
+
+
 class TestSyntheticLanes:
     def test_lanes_deterministic_and_encodable(self):
         from jepsen_tpu.history import entries as make_entries

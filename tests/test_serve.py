@@ -1,0 +1,389 @@
+"""The resident verdict service (jepsen_tpu/serve/): durable-queue
+exactly-once semantics, weighted-round-robin fairness, bounded
+admission, bundle staleness, breaker state shared across queued
+clients, cross-run batch packing equivalence, and the HTTP surface —
+all sim-backed on CPU (the chaos SIGKILL e2e lives in
+test_serve_chaos.py)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import independent
+from jepsen_tpu.checker import supervisor as sup_mod
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.history import index as index_history, invoke_op, ok_op
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.serve import DurableQueue, EngineBundle, EngineRegistry, QueueFull
+from jepsen_tpu.serve import bundle as bundle_mod
+from jepsen_tpu.serve import daemon as daemon_mod
+from jepsen_tpu.serve import registry as registry_mod
+from jepsen_tpu.testlib import FlakyEngine
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons():
+    """Daemon paths route through the checker.supervisor singletons;
+    never leak a test supervisor (tripped breakers) across tests."""
+    yield
+    sup_mod._reset_for_tests(None)
+
+
+def _register_history(k="x", good=True) -> list:
+    """One keyed CAS-register history as it arrives over HTTP: plain
+    JSON dicts, KVTuple values flattened to [k, v] lists."""
+    v = 1 if good else 2  # read 2 after write 1 -> not linearizable
+    return [
+        {"process": 0, "type": "invoke", "f": "write", "value": [k, 1],
+         "time": 0},
+        {"process": 0, "type": "ok", "f": "write", "value": [k, 1],
+         "time": 1},
+        {"process": 1, "type": "invoke", "f": "read", "value": [k, None],
+         "time": 2},
+        {"process": 1, "type": "ok", "f": "read", "value": [k, v],
+         "time": 3},
+    ]
+
+
+def host_batch(model, ess, max_steps=None, time_limit=None):
+    return sup_mod._run_host(model, ess, max_steps=max_steps,
+                             time_limit=time_limit)
+
+
+def _supervisor(registry, **kw) -> sup_mod.Supervisor:
+    base = dict(backoff_base=0.001, backoff_cap=0.002,
+                breaker_threshold=2, breaker_cooldown=300.0)
+    base.update(kw)
+    return sup_mod.Supervisor(sup_mod.SupervisorConfig(**base),
+                              registry=registry, eligibility={})
+
+
+class TestDurableQueue:
+    def test_submit_durable_before_ack(self, tmp_path):
+        q = DurableQueue(str(tmp_path / "q"))
+        jid = q.submit("alice", "register", _register_history())
+        # a brand-new instance (a post-SIGKILL restart) sees the job
+        q2 = DurableQueue(str(tmp_path / "q"))
+        assert q2.pending_ids() == [jid]
+        assert q2.verdict(jid) is None
+
+    def test_admission_bound_rejects_with_retry_hint(self, tmp_path):
+        q = DurableQueue(str(tmp_path / "q"), max_pending=2,
+                         retry_after_s=7.0)
+        q.submit("a", "register", [])
+        q.submit("a", "register", [])
+        with pytest.raises(QueueFull) as ei:
+            q.submit("b", "register", [])
+        assert ei.value.pending == 2
+        assert ei.value.retry_after_s == 7.0
+        # committing one reopens admission
+        q.commit(q.pending_ids()[0], {"valid": True})
+        q.submit("b", "register", [])
+
+    def test_weighted_round_robin_fairness(self, tmp_path):
+        q = DurableQueue(str(tmp_path / "q"))
+        for i in range(4):
+            q.submit("alice", "register", [], weight=1)
+            q.submit("bob", "register", [], weight=2)
+        batch = q.take_batch()
+        order = [(s["client"], s["seq"]) for s in batch]
+        # each round: alice 1 share, bob 2 — the chatty-but-light
+        # client interleaves instead of queuing behind bob's backlog
+        assert order == [("alice", 0), ("bob", 1), ("bob", 3),
+                         ("alice", 2), ("bob", 5), ("bob", 7),
+                         ("alice", 4), ("alice", 6)]
+
+    def test_exactly_once_across_restart(self, tmp_path):
+        root = str(tmp_path / "q")
+        q = DurableQueue(root)
+        ids = [q.submit("a", "register", _register_history(str(i)))
+               for i in range(3)]
+        q.commit(ids[0], {"valid": True})
+        # "SIGKILL": drop the instance, recover from disk
+        q2 = DurableQueue(root)
+        assert q2.pending_ids() == ids[1:]
+        assert q2.verdict(ids[0]) == {"valid": True}
+        # a duplicate commit (crash replay racing the first write)
+        # cannot overwrite the committed verdict
+        q2.commit(ids[0], {"valid": False})
+        assert q2.verdict(ids[0]) == {"valid": True}
+
+    def test_unknown_id_raises(self, tmp_path):
+        q = DurableQueue(str(tmp_path / "q"))
+        with pytest.raises(KeyError):
+            q.verdict("00000042-ghost")
+
+    def test_wait_for_commit_after_streams_fresh_ids(self, tmp_path):
+        q = DurableQueue(str(tmp_path / "q"))
+        jid = q.submit("a", "register", [])
+        assert q.wait_for_commit_after({jid}, timeout=0.01) == []
+        t = threading.Timer(0.05, q.commit, (jid, {"valid": True}))
+        t.start()
+        assert q.wait_for_commit_after(set(), timeout=5.0) == [jid]
+        t.join()
+
+
+class TestBundleStaleness:
+    @pytest.fixture
+    def quiet_bundle(self, tmp_path, monkeypatch):
+        """A bundle whose warm pass and calibration are stubbed out —
+        these tests exercise the fingerprint/manifest logic, not the
+        compiles (bench.py times the real thing)."""
+        calls = []
+        monkeypatch.setattr(
+            EngineBundle, "_warm_engines",
+            lambda self: calls.append("warm") or {"search": [], "closure": []})
+        monkeypatch.setattr(EngineBundle, "_activate_caches",
+                            lambda self: calls.append("activate"))
+        from jepsen_tpu.checker import calibrate
+
+        monkeypatch.setattr(calibrate, "calibration", lambda: None)
+        b = EngineBundle(str(tmp_path / "bundle"))
+        return b, calls
+
+    def test_cold_build_then_warm_replay(self, quiet_bundle):
+        b, calls = quiet_bundle
+        first = b.ensure()
+        assert first["warm"] is False
+        assert b.load_manifest()["fingerprint"] == bundle_mod.fingerprint()
+        calls.clear()
+        second = b.ensure()
+        assert second["warm"] is True
+        # warm start still replays the bucket compiles — in the
+        # background, against the pinned disk cache — and never
+        # rebuilds the manifest
+        second["warm_thread"].join(timeout=30)
+        assert calls == ["activate", "warm"]
+
+    def test_any_fingerprint_change_rebuilds(self, quiet_bundle,
+                                             monkeypatch):
+        b, calls = quiet_bundle
+        b.ensure()
+        assert b.is_fresh()
+        # kernel code edit -> digest moves -> stale, full rebuild
+        monkeypatch.setattr(bundle_mod, "code_digest", lambda: "deadbeef")
+        assert not b.is_fresh()
+        out = b.ensure()
+        assert out["warm"] is False
+        assert b.load_manifest()["fingerprint"]["code"] == "deadbeef"
+
+    def test_torn_manifest_is_stale(self, quiet_bundle):
+        b, _ = quiet_bundle
+        b.ensure()
+        with open(b.manifest_path, "w") as f:
+            f.write('{"fingerprint": ')  # torn write
+        assert not b.is_fresh()
+        assert b.ensure()["warm"] is False  # rebuilt, not crashed
+
+    def test_warm_start_seeds_persisted_calibration(self, quiet_bundle,
+                                                    monkeypatch):
+        from jepsen_tpu.checker import calibrate
+
+        b, _ = quiet_bundle
+        b.ensure()
+        m = b.load_manifest()
+        m["calibration"] = {"t_rt": 0.5, "per_lane_pallas": 0.001,
+                            "per_lane_native": 0.002}
+        from jepsen_tpu import store
+
+        store.atomic_write_json(b.manifest_path, m)
+        seeded = []
+        monkeypatch.setattr(calibrate, "seed", seeded.append)
+        assert b.ensure()["warm"] is True
+        assert seeded == [calibrate.Calibration(0.5, 0.001, 0.002)]
+
+
+class TestBreakerSharedAcrossClients:
+    def test_two_queued_clients_ride_one_quarantine(self, tmp_path):
+        """Satellite: two queued histories arriving at a quarantined
+        engine must BOTH degrade down the ladder without re-tripping
+        (or resetting) the shared breaker — the registry delegates to
+        the process-wide supervisor, so client A's trip is client B's
+        routing decision."""
+        flaky = FlakyEngine(host_batch, schedule=["fail"] * 99)
+        sup = _supervisor({"pallas": flaky, "host": host_batch},
+                          max_retries=0, breaker_threshold=2)
+        sup_mod._reset_for_tests(sup)
+        # quarantine pallas the way production does: failures trip it
+        from jepsen_tpu.history import Op, entries as make_entries
+
+        probe_hist = [Op(0, "invoke", "write", 1, time=0, index=0),
+                      Op(0, "ok", "write", 1, time=1, index=1)]
+        for _ in range(2):
+            sup.run(CASRegister(None), [make_entries(probe_hist)],
+                    ladder=("pallas", "host"))
+        assert not sup.healthy("pallas")
+        trips_before = sup.telemetry.snapshot()["breaker_trips"]
+        assert trips_before == 1
+        calls_before = flaky.calls
+
+        # two clients, two separate worker batches (batch_max=1)
+        reg = EngineRegistry(None)
+        reg._workloads["register"] = {
+            "checker": independent.checker(
+                Linearizable(CASRegister(None), algorithm="pallas")),
+            "rehydrate":
+                registry_mod._register_workload()["rehydrate"],
+            "packable": True,
+        }
+        q = DurableQueue(str(tmp_path / "q"))
+        dm = daemon_mod.VerdictDaemon(q, reg, batch_max=1)
+        dm.start()
+        try:
+            j1 = q.submit("alice", "register", _register_history("a"))
+            j2 = q.submit("bob", "register", _register_history("b"))
+            v1 = q.wait_for_verdict(j1, timeout=120)
+            v2 = q.wait_for_verdict(j2, timeout=120)
+        finally:
+            dm.draining.set()
+        # both degraded to a real verdict...
+        assert v1["valid"] is True
+        assert v2["valid"] is True
+        # ...neither attempted the quarantined engine...
+        assert flaky.calls == calls_before
+        # ...and neither re-tripped nor reset the shared breaker
+        assert sup.telemetry.snapshot()["breaker_trips"] == trips_before
+        assert not sup.healthy("pallas")
+        snap = sup.health_snapshot()
+        assert snap["degraded"] is True
+        assert snap["engines"]["pallas"]["healthy"] is False
+        assert snap["engines"]["pallas"]["cooldown_s"] > 0
+
+
+class TestPackCheck:
+    def _history_ops(self, keys, good=True):
+        ops = []
+        for k in keys:
+            v = 1 if good else 2
+            ops.append(invoke_op(0, "write", independent.tuple_(k, 1)))
+            ops.append(ok_op(0, "write", independent.tuple_(k, 1)))
+            ops.append(invoke_op(1, "read", independent.tuple_(k, None)))
+            ops.append(ok_op(1, "read", independent.tuple_(k, v)))
+        return index_history(ops)
+
+    @staticmethod
+    def _norm(r):
+        r = dict(r)
+        r.pop("supervision", None)
+        return json.loads(json.dumps(r, sort_keys=True, default=str))
+
+    def test_packed_verdicts_match_one_shot(self):
+        """Cross-run packing must be invisible in the verdict bits:
+        many jobs flattened into one check_batch == each job checked
+        alone (P-compositionality, per-lane engines)."""
+        chk = independent.checker(
+            Linearizable(CASRegister(None), algorithm="host"))
+        test = {"name": "pack-equivalence"}
+        jobs = [self._history_ops(["a", "b"], good=True),
+                self._history_ops(["c"], good=False),
+                self._history_ops(["d", "e", "f"], good=True)]
+        packed = independent.pack_check(chk, test, jobs)
+        solo = [chk.check(test, h, {}) for h in jobs]
+        assert [self._norm(p) for p in packed] == \
+            [self._norm(s) for s in solo]
+        assert [p["valid"] for p in packed] == [True, False, True]
+
+    def test_pack_falls_back_without_check_batch(self):
+        class NoBatch:
+            def check(self, test, history, opts=None):
+                return {"valid": True, "n": len(history)}
+
+        chk = independent.checker(Linearizable(CASRegister(None)))
+        chk.checker = NoBatch()
+        jobs = [self._history_ops(["a"]), self._history_ops(["b"])]
+        out = independent.pack_check(chk, {"name": "t"}, jobs)
+        assert [r["valid"] for r in out] == [True, True]
+
+
+class TestDaemonHTTP:
+    @pytest.fixture
+    def served(self, tmp_path):
+        reg = EngineRegistry(None)
+        q = DurableQueue(str(tmp_path / "q"), max_pending=4)
+        server, dm = daemon_mod.serve(q, reg, port=0)
+        base = f"http://127.0.0.1:{server.server_port}"
+        yield base, q, dm
+        dm.draining.set()
+        server.shutdown()
+
+    @staticmethod
+    def _get(url):
+        with urllib.request.urlopen(url, timeout=120) as r:
+            return r.status, json.loads(r.read())
+
+    @staticmethod
+    def _post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+
+    def test_submit_check_verdict_roundtrip(self, served):
+        base, _q, _dm = served
+        code, body = self._post(base + "/submit", {
+            "client": "c1", "workload": "register",
+            "history": _register_history("k", good=False)})
+        assert code == 200
+        code, body = self._get(
+            base + f"/verdict/{body['id']}?wait=120")
+        assert code == 200
+        assert body["verdict"]["valid"] is False
+
+    def test_health_ready_stats(self, served):
+        base, _q, dm = served
+        assert self._get(base + "/healthz") == (200, {"ok": True})
+        code, ready = self._get(base + "/readyz")
+        assert code == 200
+        assert ready["bundle"] == {"present": False, "warm": False,
+                                   "elapsed_s": None}
+        assert "degraded" in ready
+        code, stats = self._get(base + "/stats")
+        assert code == 200
+        assert stats["max_pending"] == 4
+        # draining flips readiness to 503 (and closes admission)
+        dm.draining.set()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(base + "/readyz")
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base + "/submit",
+                       {"client": "c", "workload": "register",
+                        "history": []})
+        assert ei.value.code == 503
+
+    def test_unknown_workload_and_job(self, served):
+        base, _q, _dm = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base + "/submit",
+                       {"client": "c", "workload": "nope", "history": []})
+        assert ei.value.code == 400
+        assert "register" in json.loads(ei.value.read())["workloads"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(base + "/verdict/00000099-ghost")
+        assert ei.value.code == 404
+
+    def test_queue_full_maps_to_429_with_retry_after(self, tmp_path):
+        reg = EngineRegistry(None)
+        q = DurableQueue(str(tmp_path / "q"), max_pending=0,
+                         retry_after_s=9.0)
+        server, dm = daemon_mod.serve(q, reg, port=0)
+        try:
+            base = f"http://127.0.0.1:{server.server_port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(base + "/submit",
+                           {"client": "c", "workload": "register",
+                            "history": _register_history()})
+            assert ei.value.code == 429
+            assert ei.value.headers["Retry-After"] == "9"
+            assert json.loads(ei.value.read())["retry_after_s"] == 9.0
+        finally:
+            dm.draining.set()
+            server.shutdown()

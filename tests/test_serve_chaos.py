@@ -1,0 +1,207 @@
+"""Chaos e2e for the resident verdict daemon (tests/serve_driver.py):
+SIGKILL the daemon mid-queue, restart it over the same queue
+directory, and require every submitted history to get EXACTLY one
+verdict, bit-identical to checking the same history one-shot. Plus the
+serve-subcommand signal contract: SIGTERM drains and exits 143 in both
+web-UI and daemon modes."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env(**extra) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JEPSEN_TPU_CALIB_CACHE"] = "off"
+    env.update(extra)
+    return env
+
+
+def _wait_http(url: str, timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            urllib.request.urlopen(url, timeout=5).close()
+            return
+        except urllib.error.HTTPError:
+            return  # an HTTP status IS a listening server
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _submit(port: int, client: str, history: list) -> str:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/submit",
+        data=json.dumps({"client": client, "workload": "register",
+                         "history": history}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())["id"]
+
+
+def _register_history(k: str, good: bool) -> list:
+    v = 1 if good else 2
+    return [
+        {"process": 0, "type": "invoke", "f": "write", "value": [k, 1],
+         "time": 0},
+        {"process": 0, "type": "ok", "f": "write", "value": [k, 1],
+         "time": 1},
+        {"process": 1, "type": "invoke", "f": "read", "value": [k, None],
+         "time": 2},
+        {"process": 1, "type": "ok", "f": "read", "value": [k, v],
+         "time": 3},
+    ]
+
+
+def _one_shot_verdict(history: list) -> dict:
+    """The reference leg: the SAME workload checker the daemon builds,
+    run one-shot in this process, normalized the same way."""
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.history import Op, index as index_history
+    from jepsen_tpu.serve.daemon import _jsonable
+    from jepsen_tpu.serve.registry import _register_workload
+
+    wl = _register_workload()
+    ops = [wl["rehydrate"](Op.from_dict(d)) for d in history]
+    v = check_safe(wl["checker"], {"name": "serve-register"},
+                   index_history(ops))
+    return _jsonable(v)
+
+
+def _strip(verdict: dict) -> dict:
+    v = dict(verdict)
+    v.pop("supervision", None)
+    return v
+
+
+VALIDITY = [True, False, True, True, False, True]
+
+
+class TestServeChaos:
+    def test_sigkill_mid_queue_then_restart_is_exactly_once(
+            self, tmp_path):
+        queue_dir = str(tmp_path / "queue")
+        port = _free_port()
+        # one job per batch, a fat pause between batches: the SIGKILL
+        # window (some verdicts committed, specs still pending) is wide
+        # and deterministic
+        env = _env(JEPSEN_TPU_SERVE_BATCH_MAX="1",
+                   JEPSEN_TPU_SERVE_PACE_S="1.0")
+        cmd = [sys.executable, "-m", "tests.serve_driver", queue_dir,
+               str(port)]
+        proc = subprocess.Popen(cmd, cwd=ROOT, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        try:
+            _wait_http(f"http://127.0.0.1:{port}/healthz", 90)
+            histories = [_register_history(f"k{i}", good)
+                         for i, good in enumerate(VALIDITY)]
+            ids = [_submit(port, f"client-{i % 2}", h)
+                   for i, h in enumerate(histories)]
+
+            verdicts_dir = os.path.join(queue_dir, "verdicts")
+            deadline = time.monotonic() + 240
+            while True:
+                done = [f for f in os.listdir(verdicts_dir)
+                        if f.endswith(".json")]
+                if 0 < len(done) < len(ids):
+                    break
+                assert time.monotonic() < deadline, \
+                    f"never reached mid-queue: {len(done)} committed"
+                time.sleep(0.02)
+            proc.kill()
+            assert proc.wait(timeout=30) == -signal.SIGKILL
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+        # restart over the same queue directory: recovery re-enqueues
+        # every unanswered spec, loses nothing, re-answers nothing
+        port2 = _free_port()
+        proc2 = subprocess.Popen(
+            [sys.executable, "-m", "tests.serve_driver", queue_dir,
+             str(port2)],
+            cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_http(f"http://127.0.0.1:{port2}/healthz", 90)
+            deadline = time.monotonic() + 300
+            while True:
+                done = {f[:-5] for f in os.listdir(verdicts_dir)
+                        if f.endswith(".json")}
+                if done >= set(ids):
+                    break
+                assert time.monotonic() < deadline, \
+                    f"drain incomplete: {len(done)}/{len(ids)}"
+                time.sleep(0.1)
+            # graceful drain: SIGTERM -> 143
+            proc2.terminate()
+            assert proc2.wait(timeout=90) == 143
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+                proc2.wait(timeout=30)
+
+        # EXACTLY one verdict per submission, nothing extra
+        files = sorted(f[:-5] for f in os.listdir(verdicts_dir)
+                       if f.endswith(".json"))
+        assert files == sorted(ids)
+        jobs = sorted(f[:-5] for f in os.listdir(
+            os.path.join(queue_dir, "jobs")) if f.endswith(".json"))
+        assert jobs == sorted(ids)
+
+        # and each verdict is bit-identical to a one-shot check of the
+        # same history (modulo supervision telemetry, which is
+        # scheduling-dependent by design)
+        for jid, hist, good in zip(ids, histories, VALIDITY):
+            with open(os.path.join(verdicts_dir, jid + ".json")) as f:
+                rec = json.load(f)
+            assert rec["id"] == jid
+            daemon_v = _strip(rec["verdict"])
+            assert daemon_v["valid"] is good
+            assert daemon_v == _strip(_one_shot_verdict(hist))
+
+
+class TestServeSignalContract:
+    def test_web_ui_serve_exits_143_on_sigterm(self, tmp_path):
+        port = _free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "jepsen_tpu.cli", "serve",
+             "--host", "127.0.0.1", "--port", str(port),
+             "--store-dir", str(tmp_path / "store")],
+            cwd=ROOT, env=_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_http(f"http://127.0.0.1:{port}/", 90)
+            proc.terminate()
+            assert proc.wait(timeout=60) == 143
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
