@@ -198,18 +198,35 @@ def _load_disk_cache() -> Calibration | None:
         return None
 
 
-def _save_disk_cache(cal: Calibration) -> None:
+def _update_disk_cache(**fields) -> None:
+    """Merge `fields` into the on-disk record, keeping other sections
+    (the batch-min calibration and the mesh crossover share one
+    fingerprint-stamped file). A stale or torn record is replaced
+    wholesale."""
     p = cache_path()
     if not p:
         return
     try:
         from .. import store
 
-        store.atomic_write_json(
-            p, {"fingerprint": device_fingerprint(),
-                "calibration": asdict(cal)})
+        rec: dict = {}
+        try:
+            with open(p) as f:
+                old = json.load(f)
+            if (isinstance(old, dict)
+                    and old.get("fingerprint") == device_fingerprint()):
+                rec = old
+        except (OSError, ValueError):
+            pass
+        rec["fingerprint"] = device_fingerprint()
+        rec.update(fields)
+        store.atomic_write_json(p, rec)
     except Exception:  # noqa: BLE001 — persistence is best-effort
         log.debug("couldn't persist calibration cache", exc_info=True)
+
+
+def _save_disk_cache(cal: Calibration) -> None:
+    _update_disk_cache(calibration=asdict(cal))
 
 
 def _measure() -> Calibration | None:
@@ -311,6 +328,155 @@ def batch_min() -> int | None:
     return None if cal is None else cal.batch_min
 
 
+# ---------------------------------------------------------------------------
+# Mesh-vs-single crossover (the pod-scale rungs' routing bar).
+#
+# The closure mesh rung pays one all-gather of the packed bitmat per
+# squaring round for a D-way split of the matmul; the WGL mesh rung
+# pays per-device dispatch + empty-lane chunk padding for a D-way
+# split of the lane pack. Both only win past a size bar, and like
+# batch_min that bar is a property of the backend, not of policy —
+# so it's measured (closure, on real multi-device TPU backends, and
+# persisted next to the batch-min record) or derived from the device
+# count (lanes), with env pins for operators who know their mesh.
+
+MESH_MIN_N_DEFAULT = 2048    # closure: adjacency side where block-row
+#                              sharding starts winning (conservative —
+#                              below it one chip's matmul is cheap and
+#                              the all-gather dominates)
+MESH_LANES_MIN_DEFAULT = 64  # wgl: fewer lanes than this aren't worth
+#                              dealing even on wide meshes
+MESH_NEVER = 1 << 30         # "mesh never wins on this backend"
+MESH_CAL_SIZES = (512, 2048)  # measured closure sizes (pow2 buckets)
+
+_ENV_MESH_N = "JEPSEN_TPU_MESH_MIN_N"
+_ENV_MESH_LANES = "JEPSEN_TPU_MESH_LANES_MIN"
+
+_mesh_cached = False
+_mesh_min_n: int | None = None  # measured; None = unmeasured/failed
+
+
+def _measure_mesh_min_n() -> int | None:
+    """Time single-device vs mesh closure at MESH_CAL_SIZES; the
+    crossover is the smallest measured size where the mesh wall wins,
+    MESH_NEVER when it never does. Both paths warm first so the race
+    measures steady-state launches, not compiles."""
+    import numpy as np
+
+    import jax
+
+    from ..ops import closure_tpu
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+
+    def wall(fn) -> float:
+        fn()  # warm: compile + first launch
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    for n in MESH_CAL_SIZES:
+        a = np.random.default_rng(11).random((n, n)) < (2.0 / n)
+        t_single = wall(lambda: closure_tpu.reach_batch([a]))
+        t_mesh = wall(
+            lambda: closure_tpu.reach_batch([a], devices=devices))
+        if t_mesh <= t_single:
+            return n
+    return MESH_NEVER
+
+
+def mesh_min_n() -> int:
+    """The smallest adjacency side the closure_mesh rung should take.
+    ``JEPSEN_TPU_MESH_MIN_N`` pins it; otherwise measured once per
+    process on real multi-device TPU backends (disk-cached, stamped
+    with the same fingerprint as the batch-min record); otherwise the
+    documented conservative default."""
+    global _mesh_cached, _mesh_min_n
+    env = os.environ.get(_ENV_MESH_N)
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer %s=%r", _ENV_MESH_N, env)
+    if not _mesh_cached:
+        with _lock:
+            if not _mesh_cached:
+                v = None
+                try:
+                    import jax
+
+                    if (jax.devices()[0].platform == "tpu"
+                            and jax.device_count() >= 2):
+                        v = _load_disk_mesh()
+                        if v is None:
+                            v = _measure_mesh_min_n()
+                            if v is not None:
+                                _update_disk_cache(mesh_min_n=v)
+                                log.info("calibrated mesh crossover: "
+                                         "mesh_min_n=%d", v)
+                except Exception:  # noqa: BLE001 — never fail a check
+                    log.debug("mesh crossover calibration failed",
+                              exc_info=True)
+                    v = None
+                _mesh_min_n, _mesh_cached = v, True
+    return _mesh_min_n if _mesh_min_n is not None else MESH_MIN_N_DEFAULT
+
+
+def _load_disk_mesh() -> int | None:
+    p = cache_path()
+    if not p:
+        return None
+    try:
+        with open(p) as f:
+            rec = json.load(f)
+        if rec.get("fingerprint") != device_fingerprint():
+            return None
+        v = rec.get("mesh_min_n")
+        return int(v) if v is not None else None
+    except Exception:  # noqa: BLE001 — a bad cache is just a miss
+        return None
+
+
+def mesh_lanes_min() -> int:
+    """The smallest lane batch the wgl_mesh rung should take:
+    ``JEPSEN_TPU_MESH_LANES_MIN`` or a few chunks per device (the
+    dealing is cheap; the bar only filters batches whose chunks would
+    be mostly empty-lane padding)."""
+    env = os.environ.get(_ENV_MESH_LANES)
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer %s=%r", _ENV_MESH_LANES,
+                        env)
+    try:
+        import jax
+
+        n_dev = jax.device_count()
+    except Exception:  # noqa: BLE001 — no usable backend
+        n_dev = 1
+    return max(MESH_LANES_MIN_DEFAULT, 4 * n_dev)
+
+
+def measured_mesh_min_n() -> int | None:
+    """The measured (or seeded) mesh crossover, None when this
+    process never measured one — what the AOT bundle persists (the
+    default fallback is policy, not a measurement)."""
+    mesh_min_n()
+    return _mesh_min_n
+
+
+def seed_mesh(v: int | None) -> None:
+    """Install a previously-measured mesh crossover (the AOT bundle's
+    warm-start path, mirroring seed())."""
+    global _mesh_cached, _mesh_min_n
+    with _lock:
+        _mesh_min_n = None if v is None else int(v)
+        _mesh_cached = True
+
+
 def seed(cal: Calibration | None) -> None:
     """Install a previously-measured Calibration as this process's
     cached measurement without re-measuring — the AOT engine bundle's
@@ -327,7 +493,9 @@ def _reset_for_tests() -> None:
     """Drop the in-memory cache (test hook). The on-disk cache is NOT
     touched — tests isolate it by pointing JEPSEN_TPU_CALIB_CACHE at a
     scratch file (or "off")."""
-    global _cached, _calibration
+    global _cached, _calibration, _mesh_cached, _mesh_min_n
     with _lock:
         _cached = False
         _calibration = None
+        _mesh_cached = False
+        _mesh_min_n = None

@@ -106,7 +106,8 @@ def _unpack_closure(d) -> np.ndarray:
 
 def _closures(mats, engine=None) -> list:
     """Closure of every matrix, through the supervised ladder by
-    default or a pinned engine ("host" / "tpu") for parity tooling."""
+    default or a pinned engine ("host" / "tpu" / "mesh") for parity
+    tooling."""
     if not mats:
         return []
     if engine == "host":
@@ -115,6 +116,10 @@ def _closures(mats, engine=None) -> list:
         from ...ops import closure_tpu
 
         return closure_tpu.reach_batch(mats)
+    if engine == "mesh":
+        from ...ops import closure_tpu
+
+        return closure_tpu.reach_batch_mesh(mats)
     from .. import supervisor as sup_mod
 
     sup = sup_mod.get_closure()
@@ -199,6 +204,13 @@ def classify(g: DepGraph, anomalies=ANOMALIES, *, realtime=False,
             sup_mod.get_closure().telemetry.record("journal_skips",
                                                    skips)
     todo = [i for i, x in enumerate(closed) if x is None]
+    # Component dealing: submit the batch LARGEST-first. Supervision
+    # chunks split the list in submission order, so descending size
+    # groups same-pad-bucket components into the same launches, and
+    # the mesh rung's eligibility (which keys on the biggest matrix
+    # in a chunk) sees the pod-scale components up front instead of
+    # buried behind a run of singletons. Results realign by index.
+    todo.sort(key=lambda i: -mats[i].shape[0])
     for i, sub in zip(todo, _closures([mats[i] for i in todo],
                                       engine=engine)):
         closed[i] = sub

@@ -64,8 +64,11 @@ from dataclasses import dataclass
 log = logging.getLogger("jepsen_tpu.checker.supervisor")
 
 #: The degradation ladder, best rung first. Every rung returns
-#: WGLResults with identical verdict semantics.
-LADDER = ("pallas", "tpu", "native", "host")
+#: WGLResults with identical verdict semantics. wgl_mesh is the XLA
+#: kernel dealt over every addressable device (ops/wgl_tpu mesh path);
+#: any mesh failure — device loss, OOM, collective timeout — demotes
+#: to the proven single-device rungs, never to a wrong verdict.
+LADDER = ("pallas", "wgl_mesh", "tpu", "native", "host")
 
 #: Telemetry counter names (fixed so snapshots/deltas are total).
 COUNTERS = (
@@ -294,6 +297,21 @@ def _run_tpu(model, ess, max_steps=None, time_limit=None):
     return list(wgl_tpu.analysis_batch(model, ess, **kw))
 
 
+def _run_wgl_mesh(model, ess, max_steps=None, time_limit=None):
+    """The XLA search kernel with lane packs sharded over the
+    ("keys",) mesh of every addressable device (longest-first dealt,
+    empty-lane padded — ops/wgl_tpu.analysis_batch's mesh path)."""
+    import jax
+
+    from ..ops import wgl_tpu
+
+    if max_steps is None and time_limit is not None:
+        max_steps = _steps_for(time_limit)
+    kw = {} if max_steps is None else {"max_steps": max_steps}
+    return list(wgl_tpu.analysis_batch(model, ess,
+                                       devices=jax.devices(), **kw))
+
+
 def _run_native(model, ess, max_steps=None, time_limit=None):
     from ..ops import wgl_native
 
@@ -318,6 +336,7 @@ def _run_linear(model, ess, max_steps=None, time_limit=None):
 def default_registry() -> dict:
     return {
         "pallas": _run_pallas,
+        "wgl_mesh": _run_wgl_mesh,
         "tpu": _run_tpu,
         "native": _run_native,
         "host": _run_host,
@@ -334,7 +353,14 @@ def default_registry() -> dict:
 # must not collide with the search engines' (probe_engine and the
 # breaker key by name). `model` is unused and passed as None.
 
-CLOSURE_LADDER = ("closure_tpu", "closure_host")
+CLOSURE_LADDER = ("closure_mesh", "closure_tpu", "closure_host")
+
+
+def _run_closure_mesh(model, adjs, max_steps=None, time_limit=None):
+    from ..ops import closure_tpu
+
+    return closure_tpu.reach_batch_mesh(adjs, max_steps=max_steps,
+                                        time_limit=time_limit)
 
 
 def _run_closure_tpu(model, adjs, max_steps=None, time_limit=None):
@@ -374,8 +400,32 @@ def _elig_closure_tpu(model, adjs) -> bool:
     return all(a.shape[0] <= CLOSURE_CPU_MAX_N for a in adjs)
 
 
+def _elig_closure_mesh(model, adjs) -> bool:
+    """The sharded squaring takes a batch when a mesh exists (>= 2
+    devices) AND the batch's biggest matrix clears the calibrated
+    mesh-vs-single crossover (checker/calibrate.mesh_min_n) — below
+    it, the per-round all-gather costs more than the D-way matmul
+    split saves. Off-TPU the same CPU cap as closure_tpu applies, so
+    routing (not degradation) sends big emulated work to the host
+    DFS."""
+    if not _elig_closure_tpu(model, adjs):
+        return False
+    try:
+        import jax
+
+        if jax.device_count() < 2:
+            return False
+    except Exception:  # noqa: BLE001 — no usable backend
+        return False
+    from . import calibrate
+
+    return bool(adjs) and max(a.shape[0] for a in adjs) \
+        >= calibrate.mesh_min_n()
+
+
 def closure_registry() -> dict:
     return {
+        "closure_mesh": _run_closure_mesh,
         "closure_tpu": _run_closure_tpu,
         "closure_host": _run_closure_host,
     }
@@ -383,6 +433,7 @@ def closure_registry() -> dict:
 
 def closure_eligibility() -> dict:
     return {
+        "closure_mesh": _elig_closure_mesh,
         "closure_tpu": _elig_closure_tpu,
         "closure_host": lambda model, adjs: True,
     }
@@ -410,6 +461,26 @@ def _elig_tpu(model, ess) -> bool:
     return jm is not None and all(jm.lane_eligible(es) for es in ess)
 
 
+def _elig_wgl_mesh(model, ess) -> bool:
+    """Lane packs shard when a mesh exists and the batch is wide
+    enough to be worth dealing (checker/calibrate.mesh_lanes_min —
+    below it the per-device chunks are mostly empty-lane padding and
+    the single-device launch wins)."""
+    if not _elig_tpu(model, ess):
+        return False
+    try:
+        import jax
+
+        n_dev = jax.device_count()
+    except Exception:  # noqa: BLE001 — no usable backend
+        return False
+    if n_dev < 2 or len(ess) < n_dev:
+        return False
+    from . import calibrate
+
+    return len(ess) >= calibrate.mesh_lanes_min()
+
+
 def _elig_native(model, ess) -> bool:
     try:
         from ..ops import wgl_native
@@ -423,6 +494,7 @@ def _elig_native(model, ess) -> bool:
 def default_eligibility() -> dict:
     return {
         "pallas": _elig_pallas,
+        "wgl_mesh": _elig_wgl_mesh,
         "tpu": _elig_tpu,
         "native": _elig_native,
         "host": lambda model, ess: True,
@@ -636,7 +708,8 @@ class Supervisor:
                 if elig is not None and not elig(model, sub):
                     demoted_here += 1
                     continue
-                if (rung in ("pallas", "tpu")
+                if (rung in ("pallas", "wgl_mesh", "tpu",
+                             "closure_mesh")
                         and self.config.probe_first_compile
                         and not self.probe_engine(rung)):
                     # first compile died in the probe subprocess — the
@@ -735,10 +808,12 @@ def _probe_main(engine: str) -> None:
     """Subprocess entry point: compile-and-run the engine's minimal
     lane. Exit status is the probe verdict; a FATAL abort here is
     contained by the parent."""
-    from ..ops import wgl_native, wgl_pallas_vec, wgl_tpu
+    from ..ops import closure_tpu, wgl_native, wgl_pallas_vec, wgl_tpu
 
     probe = {"pallas": wgl_pallas_vec.probe, "tpu": wgl_tpu.probe,
-             "native": wgl_native.probe}[engine]
+             "wgl_mesh": wgl_tpu.probe_mesh, "native": wgl_native.probe,
+             "closure_mesh": closure_tpu.probe_mesh,
+             "closure_tpu": closure_tpu.probe}[engine]
     sys.exit(0 if probe() else 1)
 
 

@@ -450,5 +450,52 @@ def serve_cmd() -> dict:
     return {"serve": Subcommand(run=run, opt_spec=opt_spec)}
 
 
+def _load_mesh_doctor():
+    """Load tools/mesh_doctor.py (a script dir, not a package) by path,
+    relative to the repo checkout this package lives in."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "mesh_doctor.py")
+    if not os.path.exists(path):
+        raise CliError(f"mesh doctor tool not found at {path}")
+    spec = importlib.util.spec_from_file_location("mesh_doctor", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def doctor_cmd() -> dict:
+    """The `doctor` subcommand: examine the device mesh — topology,
+    per-device verdict parity against the host oracle, mesh-sharded
+    WGL/closure parity and walls, HBM headroom (tools/mesh_doctor)."""
+
+    def opt_spec(p):
+        p.add_argument(
+            "--mesh", type=int, default=None, metavar="N",
+            help="Force an N-device virtual CPU mesh (must be a fresh "
+            "process: device count is fixed once jax initializes)",
+        )
+        p.add_argument(
+            "--closure-n", type=int, default=100, metavar="N",
+            help="Side of the biggest closure parity matrix",
+        )
+
+    def run(opts):
+        import json
+
+        doctor = _load_mesh_doctor()
+        report = doctor.diagnose(n_devices=opts.get("mesh"),
+                                 closure_n=opts.get("closure_n", 100))
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["ok"] else 1
+
+    return {"doctor": Subcommand(
+        run=run, opt_spec=opt_spec,
+        usage="Examine the device mesh: topology, per-device parity, "
+        "mesh-path parity, HBM headroom.")}
+
+
 if __name__ == "__main__":  # the reference's jepsen.cli/-main (cli.clj:399-402)
-    main(serve_cmd())
+    main({**serve_cmd(), **doctor_cmd()})

@@ -1,0 +1,106 @@
+"""One shared setup for CPU-hosted virtual device meshes.
+
+Three places need an n-device mesh without TPU hardware — the test
+suite (tests/conftest.py), bench.py's CPU fallback, and the mesh
+doctor (tools/mesh_doctor.py, promoted from
+``__graft_entry__.dryrun_multichip``) — and before this module each
+hand-rolled the same fragile dance: set ``JAX_PLATFORMS=cpu``, splice
+``--xla_force_host_platform_device_count=N`` into ``XLA_FLAGS``
+*before* the first jax import, then pin ``jax_platforms`` again
+*after* import because this image's sitecustomize registers an
+experimental TPU platform plugin that resets it (and initializing
+that backend can hang when the TPU tunnel is down).
+
+``force_host_device_count`` is the one copy of that dance. It also
+fixes the SIGILL warning spam the MULTICHIP_r0x dry-run tails showed:
+XLA's CPU backend logs a feature-mismatch warning ("... could lead to
+execution errors such as SIGILL") for every persisted-cache executable
+compiled under a different host CPU feature set. The forced-CPU runs
+share the default persistent compile cache with whatever host built it
+last, so the helper keys the cache directory by a digest of this
+host's CPU features — reuse stays within identical hosts, and the
+mismatch warnings (which were pure noise: the entries recompile) never
+trigger. An operator-set ``JEPSEN_TPU_COMPILE_CACHE`` is respected
+untouched.
+
+Import stays jax-free; jax is imported (and pinned) inside the call.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import sys
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+_CACHE_ENV = "JEPSEN_TPU_COMPILE_CACHE"
+
+
+def host_feature_digest() -> str:
+    """A short digest of this host's CPU feature set (the ``flags``
+    line of /proc/cpuinfo, falling back to the machine arch), so
+    compile-cache directories can be keyed per feature set."""
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    if not feats:
+        import platform
+
+        feats = platform.machine()
+    return hashlib.sha1(feats.encode()).hexdigest()[:12]
+
+
+def _isolate_cpu_compile_cache() -> None:
+    """Point the persistent compile cache at a per-host-feature-set
+    subdirectory unless the operator pinned one explicitly."""
+    if os.environ.get(_CACHE_ENV):
+        return
+    base = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "jepsen-tpu", "xla-cache")
+    if str(base).lower() in ("", "0", "off", "none"):
+        return
+    os.environ[_CACHE_ENV] = os.path.join(
+        base, f"cpu-{host_feature_digest()}")
+
+
+def force_host_device_count(n: int, *, import_jax: bool = True):
+    """Force an ``n``-device virtual CPU mesh for this process.
+
+    Must run before jax initializes its backends; the flag is read at
+    backend init. When jax is already imported AND initialized with
+    fewer devices, raises rather than silently running on the wrong
+    mesh. Returns the jax module when ``import_jax`` (the default) so
+    call sites can do ``jax = hostdev.force_host_device_count(8)``.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"{_COUNT_FLAG}={n}"
+    if _COUNT_FLAG in flags:
+        # replace any pre-existing count (e.g. a prior conftest) rather
+        # than keeping a stale one
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", opt, flags)
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+    _isolate_cpu_compile_cache()
+    already = "jax" in sys.modules
+    if not import_jax and not already:
+        return None
+    import jax
+
+    # the env var alone is NOT enough in this image: sitecustomize
+    # registers an experimental TPU platform plugin and resets
+    # jax_platforms — the config.update takes precedence
+    jax.config.update("jax_platforms", "cpu")
+    if already and len(jax.devices()) < n:
+        raise RuntimeError(
+            f"jax initialized before force_host_device_count({n}); "
+            f"have {len(jax.devices())} devices — run in a fresh process")
+    return jax

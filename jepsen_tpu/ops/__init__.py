@@ -16,6 +16,24 @@ import. Override the location with JEPSEN_TPU_COMPILE_CACHE (set to
 
 import os as _os
 
+#: the smallest shape bucket every kernel pads to — one uint32 word of
+#: packed columns for the closure engines, and the minimum history pad
+#: the search kernels compile for
+MIN_PAD = 32
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= x (minimum 2)."""
+    return 1 << max(1, int(max(2, x) - 1).bit_length())
+
+
+def pad_size(n: int, min_pad: int = MIN_PAD) -> int:
+    """The shared shape-bucketing rule: pad to a power of two, floor
+    `min_pad`. Both the WGL search kernels (history length) and the
+    closure engines (adjacency side) bucket by this so variable-size
+    work maps onto a handful of compiled shapes."""
+    return max(min_pad, next_pow2(n))
+
 
 def configure_compilation_cache(path=None, force=False):
     """Point JAX's persistent compilation cache somewhere useful.

@@ -19,11 +19,27 @@ paths, so slicing the result back out is exact.
 Closures here are irreflexive-path closures, matching the host engine:
 out[i, j] iff a path i -> ... -> j with >= 1 edge exists, so the
 diagonal marks nodes on genuine cycles.
+
+Two shape-special paths share the same fixpoint loop:
+
+- buckets that fit ONE uint32 word of columns (n <= 32) square with
+  pure bitwise ops — row i OR-folds the rows its word selects — and
+  never round-trip through float32 at all;
+- with `devices` (the supervisor's `closure_mesh` rung), the packed
+  bitmat is **block-row sharded** over a 1-D mesh via shard_map: each
+  device keeps its row block as the while-loop carry, `lax.all_gather`
+  reconstructs the full packed matrix once per round (the column view
+  each row block squares against), and the fixpoint test is a
+  `psum`-reduced equality — so the resident state per device is
+  n*n/32/D words and graphs too big for one chip's HBM close at all,
+  while same-bucket batches split their matmul work D ways. The
+  transient all-gathered matrix is the memory price of each round
+  (docs/tutorial/11-mesh.md).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import partial, reduce
 
 import numpy as np
 
@@ -31,26 +47,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import _configure_compilation_cache
+from . import MIN_PAD, _configure_compilation_cache, pad_size as _pad_size
 
 # before any kernel compiles (see ops/__init__ docstring)
 _configure_compilation_cache()
 
-MIN_PAD = 32  # one uint32 word of columns; also the smallest bucket
-
-
-def _pad_size(n: int) -> int:
-    p = MIN_PAD
-    while p < n:
-        p *= 2
-    return p
-
 
 def _pack(m):
-    """[..., n, n] 0/1 -> [..., n, n//32] uint32 (bit b of word w is
-    column w*32+b)."""
-    *lead, n, _ = m.shape
-    words = m.reshape(*lead, n, n // 32, 32).astype(jnp.uint32)
+    """[..., r, c] 0/1 -> [..., r, c//32] uint32 (bit b of word w is
+    column w*32+b). Rows and columns are independent so the mesh
+    path's row-padded (non-square) blocks pack the same way."""
+    *lead, r, c = m.shape
+    words = m.reshape(*lead, r, c // 32, 32).astype(jnp.uint32)
     return (words << jnp.arange(32, dtype=jnp.uint32)).sum(
         axis=-1, dtype=jnp.uint32)
 
@@ -87,15 +95,152 @@ def _closure_packed(words0, n: int, rounds: int):
     return words
 
 
+@partial(jax.jit, static_argnames=("rounds",))
+def _closure_packed_word(words0, rounds: int):
+    """The one-word bucket (n <= 32): each row is a single uint32, so
+    the boolean square is 32 conditional OR-folds — prod[i] = OR over
+    set bits k of row i of word[k] — with no float32 round-trip.
+    `words0` is [b, 32] uint32; semantics match _closure_packed bit
+    for bit (OR of ANDs == thresholded counting matmul)."""
+
+    def cond(carry):
+        t, _, done = carry
+        return jnp.logical_and(t < rounds, jnp.logical_not(done))
+
+    def body(carry):
+        t, words, _ = carry
+        # bit k of words[b, i] selects row k into row i's OR-fold
+        sel = [(words >> jnp.uint32(k)) & 1 for k in range(32)]
+        prod = reduce(
+            jnp.bitwise_or,
+            [jnp.where(sel[k].astype(bool), words[:, k][:, None],
+                       jnp.uint32(0)) for k in range(32)])
+        nxt = words | prod
+        done = jnp.all(nxt == words)
+        return t + 1, nxt, done
+
+    _, words, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), words0, jnp.array(False)))
+    return words
+
+
 def _closure_block(batch: np.ndarray) -> np.ndarray:
     """One device launch: [b, p, p] bool (p a pad size) -> closure."""
     b, p, _ = batch.shape
-    words0 = _pack(jnp.asarray(batch, dtype=jnp.float32))
     # ceil(log2(p)) squarings cover every simple path; one more round
     # observes the fixpoint and exits
     rounds = max(1, p.bit_length())
+    if p == MIN_PAD:
+        words0 = _pack(jnp.asarray(batch, dtype=jnp.float32))[..., 0]
+        words = _closure_packed_word(words0, rounds)
+        return np.asarray(_unpack(words[..., None], p) > 0)
+    words0 = _pack(jnp.asarray(batch, dtype=jnp.float32))
     words = _closure_packed(words0, p, rounds)
     return np.asarray(_unpack(words, p) > 0)
+
+
+# ---------------------------------------------------------------------------
+# Mesh path: block-row-sharded squaring over a 1-D device mesh.
+
+def _shard_map_fn():
+    # jax.shard_map only exists on newer jax; the experimental module
+    # spans every version this repo supports (wgl_pallas_vec idiom)
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+_mesh_kernel_cache: dict = {}
+
+
+def _mesh_kernel(mesh, p: int, rounds: int):
+    """The shard-mapped fixpoint loop for one mesh + pad bucket. The
+    carried state is each device's row block of the packed bitmat
+    ([b, rows/D, p/32] uint32); every round all-gathers the blocks
+    into the full column view, squares the local rows against it, and
+    psum-reduces the per-device "anything changed?" bit so every
+    device exits the while_loop on the same round."""
+    from jax.sharding import PartitionSpec as P
+
+    key = (tuple(d.id for d in mesh.devices.flat), p, rounds)
+    if key in _mesh_kernel_cache:
+        return _mesh_kernel_cache[key]
+
+    def sharded(words0):
+        def cond(carry):
+            t, _, done = carry
+            return jnp.logical_and(t < rounds, jnp.logical_not(done))
+
+        def body(carry):
+            t, local, _ = carry
+            # [b, rows, p/32]: every device's row blocks, in mesh
+            # order — rows beyond p are all-zero mesh padding
+            full = lax.all_gather(local, "rows", axis=1, tiled=True)
+            m_local = _unpack(local, p)
+            m_full = _unpack(full, p)[:, :p, :]
+            prod = jnp.matmul(m_local, m_full,
+                              preferred_element_type=jnp.float32)
+            nxt = _pack(jnp.logical_or(m_local > 0, prod > 0))
+            changed = jnp.any(nxt != local).astype(jnp.int32)
+            done = lax.psum(changed, "rows") == 0
+            return t + 1, nxt, done
+
+        _, words, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), words0, jnp.array(False)))
+        return words
+
+    sm = _shard_map_fn()
+    kw = dict(mesh=mesh, in_specs=P(None, "rows", None),
+              out_specs=P(None, "rows", None))
+    # the psum-ed `done` is replicated by construction; replication
+    # checking off (the kwarg was renamed check_rep -> check_vma)
+    try:
+        f = sm(sharded, check_vma=False, **kw)
+    except TypeError:
+        f = sm(sharded, check_rep=False, **kw)
+    _mesh_kernel_cache[key] = jax.jit(f)
+    return _mesh_kernel_cache[key]
+
+
+def _closure_block_mesh(batch: np.ndarray, devices) -> np.ndarray:
+    """One mesh launch: [b, p, p] bool -> closure, rows dealt in
+    contiguous blocks across `devices`. Rows pad with zeros to a
+    multiple of the mesh size (zero rows neither create nor destroy
+    paths — the same argument as the pow2 pad), so uneven block
+    counts (p not divisible by D) are exact.
+
+    The batch axis buckets to a power of two too: the kernel cache is
+    keyed (mesh, p, rounds) but jit still retraces per input shape,
+    and sharded compiles are an order of magnitude pricier than
+    single-device ones — without the bucket, every distinct
+    component-batch size a classify run produces pays a fresh mesh
+    compile. All-zero pad matrices close to zero and slice back off.
+    """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    b, p, _ = batch.shape
+    bb = 1 << max(0, b - 1).bit_length()
+    if bb != b:
+        batch = np.concatenate(
+            [batch, np.zeros((bb - b, p, p), dtype=bool)])
+    d = len(devices)
+    rows = ((p + d - 1) // d) * d
+    if rows != p:
+        padded = np.zeros((b, rows, p), dtype=bool)
+        padded[:, :p, :] = batch
+        batch = padded
+    rounds = max(1, p.bit_length())
+    words0 = _pack(jnp.asarray(batch, dtype=jnp.float32))
+    mesh = Mesh(np.array(devices), ("rows",))
+    sharding = NamedSharding(mesh, P(None, "rows", None))
+    words0 = jax.device_put(words0, sharding)
+    words = _mesh_kernel(mesh, p, rounds)(words0)
+    try:  # deferred gather (wgl_tpu idiom); np.asarray is the sync
+        words.copy_to_host_async()
+    except (AttributeError, NotImplementedError):
+        pass
+    return np.asarray(_unpack(words, p) > 0)[:b, :p, :]
 
 
 def reach(adj: np.ndarray) -> np.ndarray:
@@ -104,10 +249,13 @@ def reach(adj: np.ndarray) -> np.ndarray:
     return reach_batch([adj])[0]
 
 
-def reach_batch(adjs, max_steps=None, time_limit=None) -> list:
+def reach_batch(adjs, max_steps=None, time_limit=None,
+                devices=None) -> list:
     """Closure of each adjacency matrix in `adjs`, aligned with the
     input. Matrices are bucketed by padded size and each bucket runs
-    as ONE batched device launch. Signature matches the supervisor
+    as ONE batched device launch — single-device by default, or
+    block-row sharded over `devices` (>= 2: the supervisor's
+    `closure_mesh` rung). Signature matches the supervisor
     engine-runner convention (checker/supervisor.py); budgets are
     accepted for uniformity — the squaring loop terminates in
     <= log2(n)+1 rounds regardless.
@@ -116,6 +264,9 @@ def reach_batch(adjs, max_steps=None, time_limit=None) -> list:
     for a in adjs:
         if a.ndim != 2 or a.shape[0] != a.shape[1]:
             raise ValueError(f"adjacency must be square, got {a.shape}")
+    mesh_devs = list(devices) if devices is not None else None
+    if mesh_devs is not None and len(mesh_devs) < 2:
+        mesh_devs = None  # a 1-device "mesh" IS the single-device path
     out: list = [None] * len(adjs)
     buckets: dict = {}
     for i, a in enumerate(adjs):
@@ -128,11 +279,22 @@ def reach_batch(adjs, max_steps=None, time_limit=None) -> list:
         for j, i in enumerate(idxs):
             n = adjs[i].shape[0]
             batch[j, :n, :n] = adjs[i]
-        closed = _closure_block(batch)
+        if mesh_devs is not None:
+            closed = _closure_block_mesh(batch, mesh_devs)
+        else:
+            closed = _closure_block(batch)
         for j, i in enumerate(idxs):
             n = adjs[i].shape[0]
             out[i] = closed[j, :n, :n]
     return out
+
+
+def reach_batch_mesh(adjs, max_steps=None, time_limit=None) -> list:
+    """reach_batch over every addressable device — the supervisor's
+    `closure_mesh` engine runner (checker/supervisor.py registers it
+    above closure_tpu in CLOSURE_LADDER)."""
+    return reach_batch(adjs, max_steps=max_steps, time_limit=time_limit,
+                       devices=jax.devices())
 
 
 def probe() -> bool:
@@ -142,3 +304,16 @@ def probe() -> bool:
     a[0, 1] = a[1, 0] = True
     r = reach(a)
     return bool(r[0, 0] and r[0, 1] and not r[2, 2])
+
+
+def probe_mesh() -> bool:
+    """Compile-and-run the sharded squaring over every addressable
+    device: a ring big enough to land in a > one-word bucket, parity
+    checked against the single-device path."""
+    n = 2 * MIN_PAD + 5  # pads past the word bucket; uneven vs D too
+    a = np.zeros((n, n), dtype=bool)
+    for i in range(n):
+        a[i, (i + 1) % n] = True
+    (r,) = reach_batch([a], devices=jax.devices())
+    (s,) = reach_batch([a])
+    return bool(np.array_equal(r, s) and r[0, 0])

@@ -33,7 +33,6 @@ split.
 
 from __future__ import annotations
 
-import math
 import time as _time
 from functools import partial
 
@@ -43,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import _configure_compilation_cache
+from . import _configure_compilation_cache, next_pow2 as _next_pow2
 from ..history import Entries
 from ..models import jit as mjit
 from .wgl_host import (WGLResult, analysis as wgl_host_analysis,
@@ -68,10 +67,6 @@ N_PROBES = 8
 # dominates); compile time scales with the body, so 8 is the sweet
 # spot.
 DEFAULT_UNROLL = 8
-
-
-def _next_pow2(x: int) -> int:
-    return 1 << max(1, math.ceil(math.log2(max(2, x))))
 
 
 def encode_entries(es: Entries, jm, n_pad: int) -> dict:
@@ -626,8 +621,12 @@ def _kernel_for(jm, n_pad: int, n_state: int, cache_bits: int,
 
 def _pad_size(n: int) -> int:
     """Bucket entry counts to limit kernel recompiles (variable-length
-    subhistories -> a few static shapes; SURVEY.md SS7.4)."""
-    return max(32, _next_pow2(n))
+    subhistories -> a few static shapes; SURVEY.md SS7.4). The rule —
+    pow2, floor 32 — is the package-wide one (ops.pad_size), shared
+    with the closure engines' adjacency buckets."""
+    from . import pad_size
+
+    return pad_size(n)
 
 
 def _stack(ents: list[dict]) -> dict:
@@ -782,3 +781,25 @@ def probe() -> bool:
     (r,) = analysis_batch(CASRegister(None), [make_entries(h)],
                           max_steps=10_000)
     return r.valid is True
+
+
+def probe_mesh() -> bool:
+    """Compile-and-run one uneven lane batch dealt longest-first over
+    every addressable device (the wgl_mesh rung's launch shape): an
+    odd lane count exercises the empty-lane chunk padding too."""
+    from ..history import Op, entries as make_entries
+    from ..models import CASRegister
+
+    devices = jax.devices()
+    ess = []
+    for lane in range(2 * len(devices) + 1):
+        h = []
+        for i in range(1 + lane % 3):
+            h.append(Op(0, "invoke", "write", i, time=2 * i,
+                        index=2 * i))
+            h.append(Op(0, "ok", "write", i, time=2 * i + 1,
+                        index=2 * i + 1))
+        ess.append(make_entries(h))
+    rs = analysis_batch(CASRegister(None), ess, max_steps=10_000,
+                        devices=devices)
+    return all(r.valid is True for r in rs)

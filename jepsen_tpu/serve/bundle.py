@@ -106,8 +106,22 @@ def default_buckets() -> dict:
     adjacency pads (ops/closure_tpu, min 32). Kept to the small
     buckets one-shot runs and the calibration lanes actually hit —
     every extra bucket is compile seconds on the cold path for cache
-    bytes the warm path may never read."""
-    return {"search": [32, 64], "closure": [32, 64]}
+    bytes the warm path may never read.
+
+    With a multi-device backend, ``search_mesh``/``closure_mesh``
+    pre-warm the shard-mapped mesh rungs too (the fingerprint's
+    device-count field already invalidates these when the mesh
+    changes)."""
+    b: dict = {"search": [32, 64], "closure": [32, 64]}
+    try:
+        import jax
+
+        if jax.device_count() > 1:
+            b["search_mesh"] = [32]
+            b["closure_mesh"] = [64]
+    except Exception:  # noqa: BLE001 — no usable backend yet
+        pass
+    return b
 
 
 def _probe_search_bucket(n_pad: int) -> None:
@@ -141,6 +155,44 @@ def _probe_closure_bucket(pad: int) -> None:
     a = np.zeros((n, n), dtype=bool)
     a[0, 1] = a[1, 0] = True
     closure_tpu.reach(a)
+
+
+def _probe_search_mesh_bucket(n_pad: int) -> None:
+    """One mesh-dealt search compile in the bucket: an uneven lane
+    batch sharded over every addressable device — the wgl_mesh rung's
+    launch shape, through the same analysis_batch entry."""
+    import jax
+
+    from ..history import entries as make_entries, index, invoke_op, ok_op
+    from ..models import CASRegister
+    from ..ops import wgl_tpu
+
+    devices = jax.devices()
+    n_entries = max(1, n_pad // 2)
+    ess = []
+    for _ in range(2 * len(devices) + 1):
+        ops = []
+        for i in range(n_entries):
+            ops.append(invoke_op(0, "write", i))
+            ops.append(ok_op(0, "write", i))
+        ess.append(make_entries(index(ops)))
+    wgl_tpu.analysis_batch(CASRegister(None), ess, max_steps=10_000,
+                           devices=devices)
+
+
+def _probe_closure_mesh_bucket(pad: int) -> None:
+    """One sharded-squaring compile in the `pad` bucket (the
+    closure_mesh rung)."""
+    import numpy as np
+
+    import jax
+
+    from ..ops import closure_tpu
+
+    n = max(3, pad // 2 + 1)
+    a = np.zeros((n, n), dtype=bool)
+    a[0, 1] = a[1, 0] = True
+    closure_tpu.reach_batch([a], devices=jax.devices())
 
 
 class EngineBundle:
@@ -198,6 +250,13 @@ class EngineBundle:
         entirely (the fingerprint already vouched for the backend)."""
         from ..checker import calibrate
 
+        mesh = manifest.get("mesh_min_n")
+        if mesh is not None:
+            try:
+                calibrate.seed_mesh(int(mesh))
+            except (TypeError, ValueError):
+                log.warning("bundle mesh crossover unreadable; "
+                            "will remeasure")
         c = manifest.get("calibration")
         if not isinstance(c, dict):
             return
@@ -215,21 +274,21 @@ class EngineBundle:
         points. Returns {family: [buckets that warmed]}. Failures are
         contained per bucket: a bucket that can't warm simply pays its
         compile at first use, exactly as before bundles existed."""
-        warmed: dict = {"search": [], "closure": []}
-        for n_pad in self.buckets.get("search", ()):
-            try:
-                _probe_search_bucket(n_pad)
-                warmed["search"].append(n_pad)
-            except Exception:  # noqa: BLE001 — warm is best-effort
-                log.warning("search bucket %d failed to warm", n_pad,
-                            exc_info=True)
-        for pad in self.buckets.get("closure", ()):
-            try:
-                _probe_closure_bucket(pad)
-                warmed["closure"].append(pad)
-            except Exception:  # noqa: BLE001
-                log.warning("closure bucket %d failed to warm", pad,
-                            exc_info=True)
+        probes = {"search": _probe_search_bucket,
+                  "closure": _probe_closure_bucket,
+                  "search_mesh": _probe_search_mesh_bucket,
+                  "closure_mesh": _probe_closure_mesh_bucket}
+        warmed: dict = {fam: [] for fam in probes
+                        if fam in self.buckets or fam in
+                        ("search", "closure")}
+        for fam, probe in probes.items():
+            for pad in self.buckets.get(fam, ()):
+                try:
+                    probe(pad)
+                    warmed.setdefault(fam, []).append(pad)
+                except Exception:  # noqa: BLE001 — warm is best-effort
+                    log.warning("%s bucket %d failed to warm", fam, pad,
+                                exc_info=True)
         # the pallas lane kernel only compiles for real Mosaic — on a
         # CPU host interpret-mode "compiles" aren't cacheable wins
         try:
@@ -261,6 +320,10 @@ class EngineBundle:
                 "per_lane_pallas": cal.per_lane_pallas,
                 "per_lane_native": cal.per_lane_native,
             }),
+            # measured mesh-vs-single crossover (None off-TPU / on
+            # 1-device backends); warm starts seed it like the
+            # calibration so the mesh rung routes without re-racing
+            "mesh_min_n": calibrate.measured_mesh_min_n(),
             "build_s": round(time.monotonic() - t0, 3),
         }
         store.atomic_write_json(self.manifest_path, manifest)

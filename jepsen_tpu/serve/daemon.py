@@ -22,7 +22,8 @@ Endpoints (stdlib ThreadingHTTPServer, the web.py idiom)::
     GET  /verdict/<id>      the committed verdict; 202 while pending
                             (?wait=SECONDS long-polls)
     GET  /stream            JSONL of verdicts as they commit
-    GET  /healthz           liveness (200 while the process serves)
+    GET  /healthz           liveness (200 while the process serves) +
+                            the device mesh topology
     GET  /readyz            readiness: breaker + HBM + bundle state;
                             503 while draining
     GET  /stats             queue depth, per-client backlog, telemetry
@@ -232,7 +233,11 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         path = url.path
         if path == "/healthz":
-            return self._send_json(200, {"ok": True})
+            from .registry import EngineRegistry
+
+            return self._send_json(
+                200, {"ok": True,
+                      "mesh": EngineRegistry.mesh_topology()})
         if path == "/readyz":
             health = d.registry.health()
             health["draining"] = d.draining.is_set()

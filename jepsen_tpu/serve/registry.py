@@ -138,6 +138,40 @@ class EngineRegistry:
         except Exception:  # noqa: BLE001 — stats are optional
             return None
 
+    _mesh_topology_cache: dict | None = None
+
+    @classmethod
+    def mesh_topology(cls) -> dict:
+        """The device mesh this daemon checks on — platform, device
+        count/kinds, and which mesh rungs the supervisors have
+        registered — for /healthz (tools/mesh_doctor reports the same
+        shape). Static per process, so computed once: /healthz is a
+        liveness probe and must stay cheap."""
+        if cls._mesh_topology_cache is not None:
+            return cls._mesh_topology_cache
+        topo: dict = {"devices": 0, "platform": None, "kinds": []}
+        try:
+            import jax
+
+            devs = jax.devices()
+            topo = {
+                "devices": len(devs),
+                "platform": str(devs[0].platform),
+                "kinds": sorted({str(getattr(d, "device_kind", d))
+                                 for d in devs}),
+            }
+        except Exception:  # noqa: BLE001 — no usable backend
+            pass
+        from ..checker import supervisor as sup_mod
+
+        topo["mesh_rungs"] = {
+            "wgl_mesh": "wgl_mesh" in sup_mod.get().registry,
+            "closure_mesh":
+                "closure_mesh" in sup_mod.get_closure().registry,
+        }
+        cls._mesh_topology_cache = topo
+        return topo
+
     def health(self) -> dict:
         """The combined readiness picture: both supervisors'
         per-engine breaker state + telemetry, bundle warmth, HBM."""

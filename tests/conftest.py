@@ -1,24 +1,21 @@
 """Test environment: force JAX onto CPU with 8 virtual devices so all
 mesh/sharding tests run without TPU hardware (the driver separately
-dry-runs the multi-chip path; see __graft_entry__.py).
+dry-runs the multi-chip path; see tools/mesh_doctor.py).
 
-Note: the env var alone is NOT enough in this image — a sitecustomize
-registers an experimental TPU platform plugin and resets jax_platforms,
-and initializing that backend can hang when the TPU tunnel is down. The
-config.update below takes precedence and keeps tests hermetic."""
+The env juggling — JAX_PLATFORMS, the XLA device-count flag, the
+post-import jax_platforms pin this image's sitecustomize makes
+necessary, and the per-host-feature compile-cache keying that stops
+XLA's SIGILL feature-mismatch warning spam — is shared with bench.py
+and the mesh doctor via jepsen_tpu.hostdev."""
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax  # noqa: E402
+from jepsen_tpu import hostdev  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+hostdev.force_host_device_count(8)
 
 
 import pytest  # noqa: E402

@@ -1,0 +1,348 @@
+"""Pod-scale mesh engines (ISSUE 17): the block-row-sharded closure
+squaring, the mesh-dealt WGL lane packs, the supervised mesh rungs with
+single-device fallback, the calibrated crossovers, the mesh doctor, and
+the shared virtual-mesh helper.
+
+tests/conftest.py forces 8 virtual CPU devices for the whole suite
+(jepsen_tpu.hostdev), so every test here runs against a real
+multi-device mesh — the same sharded program structure a TPU pod
+compiles, on the CPU backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import calibrate
+from jepsen_tpu.checker import supervisor as sup_mod
+from jepsen_tpu.history import entries as make_entries
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.ops import closure_host, closure_tpu, wgl_host, wgl_tpu
+
+from helpers import random_register_history
+
+
+def _digraph(n, seed, avg_deg=3.0):
+    rng = np.random.default_rng(seed)
+    a = rng.random((n, n)) < (avg_deg / max(n, 1))
+    np.fill_diagonal(a, False)
+    return a
+
+
+def _devices(k):
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= k, f"conftest should have forced 8, got {len(devs)}"
+    return list(devs[:k])
+
+
+# ---------------------------------------------------------------------------
+# closure: the block-row-sharded repeated squaring
+
+
+class TestClosureMesh:
+    def test_uneven_block_counts(self):
+        """n not divisible by the mesh size: the row axis zero-pads to a
+        multiple of the device count (zero rows can't create or destroy
+        paths), so 3- and 5-device meshes over odd sizes stay exact."""
+        for d in (3, 5, 8):
+            for n in (33, 100, 129):
+                a = _digraph(n, seed=10 * d + n)
+                got = closure_tpu.reach_batch([a], devices=_devices(d))[0]
+                want = closure_host.reach(a)
+                assert np.array_equal(np.asarray(got), want), (d, n)
+
+    def test_one_device_mesh_is_single_device(self):
+        """A 1-device mesh IS the single-device path — reach_batch drops
+        the mesh machinery below 2 devices, and the results are
+        bit-identical."""
+        mats = [_digraph(65, seed=3), _digraph(40, seed=4)]
+        single = closure_tpu.reach_batch(mats)
+        one = closure_tpu.reach_batch(mats, devices=_devices(1))
+        for s, o in zip(single, one):
+            assert np.array_equal(np.asarray(s), np.asarray(o))
+
+    def test_mesh_matches_single_device_bit_identity(self):
+        mats = [_digraph(n, seed=n) for n in (17, 100, 130)]
+        single = [np.asarray(m) for m in closure_tpu.reach_batch(mats)]
+        mesh = closure_tpu.reach_batch(mats, devices=_devices(4))
+        for s, m in zip(single, mesh):
+            assert np.array_equal(s, np.asarray(m))
+
+    def test_word_bucket_skips_float_roundtrip(self):
+        """n <= 32 closures take the one-uint32-word path (static OR
+        unrolling, no float32 matmul) and must stay exact, including
+        the n=32 boundary and cycles through the diagonal rule."""
+        for n, seed in ((1, 1), (5, 2), (31, 3), (32, 4)):
+            a = _digraph(n, seed=seed, avg_deg=2.0)
+            got = closure_tpu.reach_batch([a])[0]
+            assert np.array_equal(np.asarray(got), closure_host.reach(a))
+
+    def test_probe_mesh(self):
+        assert closure_tpu.probe_mesh() is True
+
+
+# ---------------------------------------------------------------------------
+# wgl: mesh-dealt lane packs
+
+
+class TestWglMesh:
+    def test_uneven_lane_deal_matches_host(self):
+        """More lanes than a multiple of the mesh (17 over 4 devices),
+        mixed lengths and corruption: the longest-first deal plus
+        EMPTY-lane padding must reproduce the host oracle verdict for
+        every lane, in submission order."""
+        model = CASRegister()
+        ess = [make_entries(random_register_history(
+            n_process=3, n_ops=4 + 3 * (s % 7), seed=200 + s,
+            corrupt=0.3 if s % 3 == 0 else 0.0)) for s in range(17)]
+        rs = wgl_tpu.analysis_batch(model, ess, devices=_devices(4))
+        for es, r in zip(ess, rs):
+            assert r.valid == wgl_host.analysis(model, es).valid
+
+    def test_probe_mesh(self):
+        assert wgl_tpu.probe_mesh() is True
+
+
+# ---------------------------------------------------------------------------
+# supervisor: mesh rungs, routing, and chaos demotion
+
+
+@pytest.fixture
+def _fresh_supervisors():
+    yield
+    sup_mod._reset_for_tests(None)
+    sup_mod._reset_closure_for_tests(None)
+    calibrate._reset_for_tests()
+
+
+def _config(**kw):
+    base = dict(backoff_base=0.001, backoff_cap=0.002, chunk_lanes=64,
+                breaker_threshold=3, breaker_cooldown=30.0, bisect_min=1,
+                probe_first_compile=False)
+    base.update(kw)
+    return sup_mod.SupervisorConfig(**base)
+
+
+class TestSupervisedMeshRungs:
+    def test_closure_mesh_rung_routes_and_matches(
+            self, monkeypatch, _fresh_supervisors):
+        """With the crossover pinned down to 1, the default closure
+        ladder routes through closure_mesh — verdicts identical to the
+        host floor, zero demotions (eligibility is routing)."""
+        monkeypatch.setenv("JEPSEN_TPU_MESH_MIN_N", "1")
+        calibrate._reset_for_tests()
+        sup = sup_mod.Supervisor(
+            _config(), registry=sup_mod.closure_registry(),
+            eligibility=sup_mod.closure_eligibility())
+        mats = [_digraph(n, seed=n + 7) for n in (33, 100)]
+        out = sup.run(None, mats, ladder=sup_mod.CLOSURE_LADDER)
+        for a, got in zip(mats, out):
+            assert np.array_equal(np.asarray(got), closure_host.reach(a))
+        assert sup.telemetry.snapshot()["demotions"] == 0
+        assert sup_mod._elig_closure_mesh(None, mats)
+
+    def test_wgl_mesh_rung_routes_and_matches(
+            self, monkeypatch, _fresh_supervisors):
+        monkeypatch.setenv("JEPSEN_TPU_MESH_LANES_MIN", "4")
+        calibrate._reset_for_tests()
+        model = CASRegister()
+        ess = [make_entries(random_register_history(
+            n_process=3, n_ops=6 + 2 * (s % 5), seed=400 + s,
+            corrupt=0.3 if s % 4 == 0 else 0.0)) for s in range(12)]
+        assert sup_mod._elig_wgl_mesh(model, ess)
+        sup = sup_mod.Supervisor(
+            _config(), registry=sup_mod.default_registry(),
+            eligibility=sup_mod.default_eligibility())
+        out = sup.run(model, ess, ladder=("wgl_mesh", "host"))
+        for es, r in zip(ess, out):
+            assert r.valid == wgl_host.analysis(model, es).valid
+        assert sup.telemetry.snapshot()["demotions"] == 0
+
+    def test_default_routing_unchanged_below_crossover(
+            self, _fresh_supervisors):
+        """Tier-1 safety: with the default crossovers (2048 / 64+),
+        small batches stay OFF the mesh rungs — routing is identical
+        to the pre-mesh seed."""
+        mats = [_digraph(64, seed=1)]
+        assert not sup_mod._elig_closure_mesh(None, mats)
+        model = CASRegister()
+        ess = [make_entries(random_register_history(seed=s))
+               for s in range(8)]
+        assert not sup_mod._elig_wgl_mesh(model, ess)
+
+
+@pytest.mark.chaos
+class TestMeshChaos:
+    def test_closure_mesh_killed_mid_launch_salvaged(
+            self, _fresh_supervisors):
+        """A mesh shard dying mid-launch (the pod-scale failure mode)
+        demotes the chunk down the ladder; the batch still completes
+        with verdicts identical to the host oracle."""
+        calls = {"mesh": 0}
+
+        def dying_mesh(model, adjs, max_steps=None, time_limit=None):
+            calls["mesh"] += 1
+            raise RuntimeError(
+                "DATA_LOSS: shard 3 halted mid collective-permute")
+
+        registry = dict(sup_mod.closure_registry())
+        registry["closure_mesh"] = dying_mesh
+        sup = sup_mod.Supervisor(_config(max_retries=1),
+                                 registry=registry, eligibility={})
+        mats = [_digraph(n, seed=n + 70) for n in (33, 80, 129)]
+        out = sup.run(None, mats, ladder=sup_mod.CLOSURE_LADDER)
+        assert calls["mesh"] >= 1  # the rung really launched and died
+        for a, got in zip(mats, out):
+            assert np.array_equal(np.asarray(got), closure_host.reach(a))
+        assert sup.telemetry.snapshot()["demotions"] >= 1
+
+    def test_wgl_mesh_killed_mid_launch_salvaged(self, _fresh_supervisors):
+        def dying_mesh(model, ess, max_steps=None, time_limit=None):
+            raise RuntimeError("UNAVAILABLE: device 5 tunnel reset")
+
+        registry = dict(sup_mod.default_registry())
+        registry["wgl_mesh"] = dying_mesh
+        sup = sup_mod.Supervisor(_config(max_retries=1),
+                                 registry=registry, eligibility={})
+        model = CASRegister()
+        ess = [make_entries(random_register_history(
+            n_process=3, n_ops=8, seed=600 + s,
+            corrupt=0.3 if s % 2 else 0.0)) for s in range(6)]
+        out = sup.run(model, ess, ladder=("wgl_mesh", "tpu", "host"))
+        for es, r in zip(ess, out):
+            assert r.valid == wgl_host.analysis(model, es).valid
+        assert sup.telemetry.snapshot()["demotions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# calibrated crossovers
+
+
+class TestCalibration:
+    @pytest.fixture(autouse=True)
+    def _reset(self):
+        yield
+        calibrate._reset_for_tests()
+
+    def test_env_pins(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_MESH_MIN_N", "123")
+        monkeypatch.setenv("JEPSEN_TPU_MESH_LANES_MIN", "9")
+        calibrate._reset_for_tests()
+        assert calibrate.mesh_min_n() == 123
+        assert calibrate.mesh_lanes_min() == 9
+
+    def test_cpu_defaults(self, monkeypatch):
+        monkeypatch.delenv("JEPSEN_TPU_MESH_MIN_N", raising=False)
+        monkeypatch.delenv("JEPSEN_TPU_MESH_LANES_MIN", raising=False)
+        calibrate._reset_for_tests()
+        import jax
+
+        # CPU hosts never measure: the default keeps tier-1 routing
+        # identical to the seed (CLOSURE_CPU_MAX_N < the default)
+        assert calibrate.mesh_min_n() == calibrate.MESH_MIN_N_DEFAULT
+        assert calibrate.mesh_min_n() > sup_mod.CLOSURE_CPU_MAX_N
+        assert calibrate.mesh_lanes_min() == max(
+            calibrate.MESH_LANES_MIN_DEFAULT, 4 * jax.device_count())
+
+
+# ---------------------------------------------------------------------------
+# hostdev: the shared virtual-mesh helper
+
+
+class TestHostdev:
+    def test_forced_count_and_idempotence(self):
+        import jax
+
+        from jepsen_tpu import hostdev
+
+        assert jax.device_count() == 8  # conftest used the helper
+        assert hostdev.force_host_device_count(8) is jax
+        assert f"{hostdev._COUNT_FLAG}=8" in os.environ["XLA_FLAGS"]
+
+    def test_raises_when_too_late_to_grow(self):
+        from jepsen_tpu import hostdev
+
+        with pytest.raises(RuntimeError, match="fresh process"):
+            hostdev.force_host_device_count(16)
+
+    def test_feature_digest_stable_and_keys_cache(self):
+        from jepsen_tpu import hostdev
+
+        d = hostdev.host_feature_digest()
+        assert d == hostdev.host_feature_digest()
+        assert len(d) == 12
+        # conftest's forced-CPU run isolated the persistent compile
+        # cache per host feature set (the SIGILL-warning fix) unless an
+        # operator pinned a cache dir explicitly
+        cache = os.environ.get(hostdev._CACHE_ENV, "")
+        assert cache, "compile cache should be pinned after conftest"
+
+
+# ---------------------------------------------------------------------------
+# serve: mesh topology on /healthz
+
+
+class TestServeMeshTopology:
+    def test_mesh_topology(self):
+        from jepsen_tpu.serve.registry import EngineRegistry
+
+        EngineRegistry._mesh_topology_cache = None
+        topo = EngineRegistry.mesh_topology()
+        assert topo["devices"] == 8
+        assert topo["platform"] == "cpu"
+        assert topo["mesh_rungs"] == {"wgl_mesh": True,
+                                      "closure_mesh": True}
+        # cached: /healthz is a liveness probe and must stay cheap
+        assert EngineRegistry.mesh_topology() is topo
+
+
+# ---------------------------------------------------------------------------
+# the mesh doctor
+
+
+def _load_doctor():
+    from jepsen_tpu import cli
+
+    return cli._load_mesh_doctor()
+
+
+class TestMeshDoctor:
+    def test_cli_wiring(self):
+        from jepsen_tpu import cli
+
+        cmds = cli.doctor_cmd()
+        assert "doctor" in cmds
+        doctor = _load_doctor()
+        assert callable(doctor.diagnose) and callable(doctor.main)
+
+    def test_diagnose_bounded(self):
+        """A bounded in-process examination (2 of the 8 devices, small
+        closure) — topology, per-device parity, mesh parity, and the
+        overall ok flag."""
+        report = _load_doctor().diagnose(closure_n=48, max_devices=2)
+        assert report["ok"] is True
+        assert report["n_devices"] == 2
+        assert [d["ok"] for d in report["per_device"]] == [True, True]
+        assert report["wgl_mesh"]["ok"] and report["closure_mesh"]["ok"]
+
+    @pytest.mark.slow
+    def test_cli_subprocess(self):
+        """The operator path end to end: `jepsen-tpu doctor --mesh 2`
+        in a fresh process prints a JSON report and exits 0."""
+        import json
+        import subprocess
+        import sys
+
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        p = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.cli", "doctor",
+             "--mesh", "2", "--closure-n", "48"],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert p.returncode == 0, p.stderr[-2000:]
+        report = json.loads(p.stdout)
+        assert report["ok"] is True and report["n_devices"] == 2

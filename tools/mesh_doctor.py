@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Mesh doctor: is this host's device mesh safe to check verdicts on?
+
+Promoted from ``__graft_entry__.dryrun_multichip`` into a real tool:
+the dry run proved the sharded program structure once, per driver run;
+the doctor is the operator-facing version — ``jepsen-tpu doctor
+[--mesh N]`` — that reports, as JSON:
+
+mesh topology
+    platform, device count, device kinds (the same shape the serve
+    daemon exposes on /healthz).
+per-device parity
+    a small WGL lane batch runs pinned to EACH device individually and
+    its verdicts are compared against the host oracle — a device that
+    computes wrong verdicts (bad HBM, a sick core) is named, not
+    averaged away.
+mesh-path parity
+    the same lanes dealt longest-first across the WHOLE mesh
+    (ops/wgl_tpu's sharded path) and a closure batch through the
+    block-row-sharded squaring (ops/closure_tpu's mesh path), both
+    against host oracles; walls are reported so MULTICHIP artifacts
+    carry real numbers.
+HBM headroom
+    per-device bytes in use / limit, when the backend exposes them.
+
+``--mesh N`` forces an N-device virtual CPU mesh (jepsen_tpu.hostdev,
+shared with tests/conftest.py and bench.py) — must run in a fresh
+process, before jax initializes. Without it the doctor examines
+whatever devices the backend already has.
+
+Exit status: 0 healthy, 1 any parity failure or sick device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _hbm(dev) -> dict | None:
+    try:
+        stats = dev.memory_stats()
+        if not stats:
+            return None
+        out = {k: int(v) for k, v in stats.items()
+               if k in ("bytes_in_use", "bytes_limit",
+                        "peak_bytes_in_use",
+                        "largest_free_block_bytes")}
+        return out or None
+    except Exception:  # noqa: BLE001 — stats are optional
+        return None
+
+
+def _wgl_lanes(n_lanes: int):
+    """Deterministic small register lanes, a third of them corrupt so
+    parity covers refutations too."""
+    from jepsen_tpu.history import entries as make_entries
+    from tests.helpers import random_register_history
+
+    return [make_entries(random_register_history(
+        n_process=3, n_ops=4 + 3 * (s % 9), seed=1000 + s,
+        corrupt=0.3 if s % 3 == 0 else 0.0))
+        for s in range(n_lanes)]
+
+
+def diagnose(n_devices: int | None = None,
+             closure_n: int = 100,
+             max_devices: int | None = None) -> dict:
+    """Run the full mesh examination; returns the report dict.
+
+    With ``n_devices``, forces that many virtual CPU devices first
+    (fresh-process requirement applies — see hostdev). ``max_devices``
+    examines only the first k devices of an already-initialized mesh —
+    for callers (tests) that want a bounded examination without
+    re-initializing jax."""
+    from jepsen_tpu import hostdev
+
+    if n_devices is not None:
+        jax = hostdev.force_host_device_count(n_devices)
+    else:
+        import jax
+
+    import numpy as np
+
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.ops import closure_host, closure_tpu, wgl_host, wgl_tpu
+
+    devices = jax.devices()
+    if max_devices is not None:
+        devices = devices[:max_devices]
+    report: dict = {
+        "platform": str(devices[0].platform),
+        "n_devices": len(devices),
+        "devices": [{"id": int(d.id),
+                     "kind": str(getattr(d, "device_kind", d)),
+                     **({"hbm": h} if (h := _hbm(d)) else {})}
+                    for d in devices],
+    }
+
+    model = CASRegister()
+    ess = _wgl_lanes(3 * len(devices) + 1)  # uneven: pads too
+    oracle = [wgl_host.analysis(model, es).valid for es in ess]
+
+    # -- per-device parity: the same batch pinned to each device alone
+    per_dev = []
+    for d in devices:
+        try:
+            rs = wgl_tpu.analysis_batch(model, ess, devices=[d])
+            bad = sum(1 for r, o in zip(rs, oracle) if r.valid != o)
+            per_dev.append({"id": int(d.id), "ok": bad == 0,
+                            **({"mismatches": bad} if bad else {})})
+        except Exception as e:  # noqa: BLE001 — a dead device is a finding
+            per_dev.append({"id": int(d.id), "ok": False,
+                            "error": f"{type(e).__name__}: {e}"})
+    report["per_device"] = per_dev
+
+    # -- whole-mesh WGL parity (longest-first deal, empty-lane pads)
+    t0 = time.perf_counter()
+    rs = wgl_tpu.analysis_batch(model, ess, devices=devices)
+    wgl_wall = time.perf_counter() - t0
+    wgl_bad = sum(1 for r, o in zip(rs, oracle) if r.valid != o)
+    report["wgl_mesh"] = {"ok": wgl_bad == 0, "lanes": len(ess),
+                          "wall_s": round(wgl_wall, 4),
+                          **({"mismatches": wgl_bad} if wgl_bad else {})}
+
+    # -- closure mesh parity (block-row-sharded squaring)
+    rng = np.random.default_rng(17)
+    mats = [rng.random((n, n)) < (4.0 / max(n, 1))
+            for n in (closure_n, closure_n // 2 + 1, 7)]
+    want = closure_host.reach_batch(mats)
+    t0 = time.perf_counter()
+    got = closure_tpu.reach_batch(mats, devices=devices)
+    cl_wall = time.perf_counter() - t0
+    cl_ok = all(np.array_equal(w, g) for w, g in zip(want, got))
+    report["closure_mesh"] = {"ok": cl_ok,
+                              "n": [int(m.shape[0]) for m in mats],
+                              "wall_s": round(cl_wall, 4)}
+
+    report["ok"] = (all(d["ok"] for d in per_dev)
+                    and report["wgl_mesh"]["ok"] and cl_ok)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--mesh", type=int, default=None, metavar="N",
+                   help="force an N-device virtual CPU mesh (fresh "
+                        "process only)")
+    p.add_argument("--closure-n", type=int, default=100, metavar="N",
+                   help="side of the biggest closure parity matrix")
+    ns = p.parse_args(argv)
+    report = diagnose(n_devices=ns.mesh, closure_n=ns.closure_n)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

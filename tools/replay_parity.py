@@ -29,6 +29,12 @@ IDENTICAL verdicts and anomaly taxonomies through both engines, and the
 raw closure matrices must agree exactly on seeded random digraphs.
 Their parity lands under "cycle" in the summary.
 
+The MESH engines replay too (the "mesh" block): the block-row-sharded
+closure squaring and the mesh-dealt WGL lane packs against their host
+oracles on raw digraphs, uneven lane batches, and end-to-end
+list-append classifications. Single-device hosts record the skip;
+`--mesh-devices N` forces an N-device virtual CPU mesh.
+
 Resumable analysis replays too: a keyed register history and a
 transactional history are analyzed with a fresh analysis journal, the
 journal is truncated mid-file (the preempted-analysis shape), and the
@@ -379,6 +385,100 @@ def replay_cycle(on_tpu: bool) -> dict:
     return out
 
 
+def replay_mesh() -> dict:
+    """Mesh-engine parity (ISSUE 17): the block-row-sharded closure
+    squaring and the mesh-dealt WGL lane packs must agree bit-for-bit /
+    verdict-for-verdict with the host oracles on this host's device
+    mesh. On a single-device host the block records the skip (the mesh
+    engines are ineligible there by construction) without failing the
+    replay; `--mesh-devices N` forces an N-device virtual CPU mesh for
+    hosts where jax would otherwise come up single-device."""
+    import jax
+    import numpy as np
+
+    from jepsen_tpu.checker import cycle
+    from jepsen_tpu.history import entries as make_entries
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.ops import closure_host, closure_tpu, wgl_host, wgl_tpu
+    from jepsen_tpu.workloads import list_append
+
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    import helpers
+
+    t0 = time.monotonic()
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"skipped_engine": "single-device host "
+                "(use --mesh-devices N)", "ok": True}
+    out: dict = {"devices": len(devices), "digraphs": 0,
+                 "closure_mismatches": 0, "wgl_lanes": 0,
+                 "wgl_mismatches": 0, "e2e_cases": 0,
+                 "e2e_mismatches": 0, "failures": 0}
+
+    # raw closure parity through the sharded path: odd sizes so the
+    # row padding (rows -> multiple of device count) is exercised
+    try:
+        for n, avg_deg, seed in ((5, 1.0, 1), (33, 4.0, 2),
+                                 (129, 3.0, 3), (200, 5.0, 4)):
+            rng = np.random.default_rng(seed)
+            a = rng.random((n, n)) < (avg_deg / n)
+            np.fill_diagonal(a, False)
+            out["digraphs"] += 1
+            got = closure_tpu.reach_batch([a], devices=devices)[0]
+            if not np.array_equal(closure_host.reach(a), np.asarray(got)):
+                out["closure_mismatches"] += 1
+                log(f"  mesh: closure disagrees at n={n} seed={seed}")
+    except Exception as e:  # noqa: BLE001 — counted, not fatal
+        out["failures"] += 1
+        log(f"  mesh: closure replay failed ({e!r}); counted")
+
+    # mesh-dealt WGL verdict parity, uneven lane count
+    try:
+        model = CASRegister()
+        ess = [make_entries(helpers.random_register_history(
+            n_process=3, n_ops=4 + 3 * (s % 9), seed=500 + s,
+            corrupt=0.3 if s % 3 == 0 else 0.0))
+            for s in range(3 * len(devices) + 1)]
+        out["wgl_lanes"] = len(ess)
+        rs = wgl_tpu.analysis_batch(model, ess, devices=devices)
+        for es, r in zip(ess, rs):
+            if r.valid != wgl_host.analysis(model, es).valid:
+                out["wgl_mismatches"] += 1
+    except Exception as e:  # noqa: BLE001
+        out["failures"] += 1
+        log(f"  mesh: wgl replay failed ({e!r}); counted")
+
+    # end-to-end: list-append histories classified with the closure
+    # pinned to the mesh engine vs the host-pinned oracle
+    def verdict(r) -> tuple:
+        return (r["valid"], tuple(sorted(r.get("anomaly-types") or ())))
+
+    for name, hist in (
+            ("list-append-600-clean",
+             list_append.simulate(600, seed=11, inject=())),
+            ("list-append-1200-injected",
+             list_append.simulate(1200, seed=7,
+                                  inject=("G1c", "G-single")))):
+        out["e2e_cases"] += 1
+        try:
+            rm = cycle.checker(engine="mesh").check({}, hist, {})
+            rh = cycle.checker(engine="host").check({}, hist, {})
+            if verdict(rm) != verdict(rh):
+                out["e2e_mismatches"] += 1
+                log(f"  mesh: e2e verdict disagrees on {name}: "
+                    f"{verdict(rm)} vs {verdict(rh)}")
+        except Exception as e:  # noqa: BLE001
+            out["failures"] += 1
+            log(f"  mesh: e2e {name} failed ({e!r}); counted")
+
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = (out["closure_mismatches"] == 0
+                 and out["wgl_mismatches"] == 0
+                 and out["e2e_mismatches"] == 0
+                 and out["failures"] == 0)
+    return out
+
+
 def _strip_supervision(x):
     """Supervision telemetry is machine-dependent; verdict parity
     compares everything else."""
@@ -474,12 +574,23 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=os.path.join(ROOT, "PARITY.json"),
                     help="summary path (default: repo-root PARITY.json)")
+    ap.add_argument(
+        "--mesh-devices", type=int, metavar="N",
+        default=int(os.environ.get("JEPSEN_TPU_REPLAY_MESH_DEVICES", 0))
+        or None,
+        help="force an N-device virtual CPU mesh before jax initializes "
+        "so the mesh parity block runs on single-device CPU hosts "
+        "(forces the CPU backend — do not use on a TPU host)")
     args = ap.parse_args(argv)
 
     cases = load_corpus()
     MODELS = models()
     log(f"corpus: {len(cases)} cases from {CORPUS}")
 
+    if args.mesh_devices:
+        from jepsen_tpu import hostdev
+
+        hostdev.force_host_device_count(args.mesh_devices)
     import jax
 
     platform = jax.devices()[0].platform
@@ -508,12 +619,16 @@ def main(argv=None) -> int:
     cycle_out = replay_cycle(on_tpu)
     log(f"  cycle: {cycle_out}")
 
+    log("replaying mesh engines ...")
+    mesh_out = replay_mesh()
+    log(f"  mesh: {mesh_out}")
+
     log("replaying resumable analysis ...")
     resume_out = replay_resume()
     log(f"  resume: {resume_out}")
 
     ok = (all(not e.get("mismatches") for e in engines.values())
-          and cycle_out["ok"] and resume_out["ok"])
+          and cycle_out["ok"] and mesh_out["ok"] and resume_out["ok"])
     # supervision telemetry (per-engine failure kinds, demotions,
     # breaker trips) for any checks that routed through the supervisor
     # during the replay — zeros on a healthy run
@@ -530,6 +645,7 @@ def main(argv=None) -> int:
         "corpus_size": len(cases),
         "engines": engines,
         "cycle": cycle_out,
+        "mesh": mesh_out,
         "resume": resume_out,
         "supervision": supervision,
         "ok": ok,
