@@ -113,6 +113,13 @@ def test_opt_spec(parser: argparse.ArgumentParser) -> None:
         help="Seconds between scheduled nemesis operations (default 10)",
     )
     parser.add_argument(
+        "--nemesis-schedule", default=argparse.SUPPRESS, metavar="FILE",
+        help="Replay an exact fault schedule from a JSON schedule "
+        "document (nemesis.combined.schedule_to_json, or a "
+        "fuzz-discovered schedule's nemesis rendering) instead of "
+        "generating one from --nemesis/--seed",
+    )
+    parser.add_argument(
         "--seed", type=int, default=argparse.SUPPRESS, metavar="N",
         help="Seed the composed nemesis package's RNG so the fault "
         "schedule is reproducible",
@@ -497,5 +504,85 @@ def doctor_cmd() -> dict:
         "mesh-path parity, HBM headroom.")}
 
 
+def fuzz_cmd() -> dict:
+    """The `fuzz` subcommand: coverage-guided fault-schedule fuzzing
+    over batched on-device cluster simulations (fuzz/). Each round
+    simulates --clusters seeded clusters in ONE supervised device
+    launch, scores every trace through the cycle checker, and keeps
+    schedules that hit new coverage buckets; discovered anomalies land
+    in <corpus-dir>/anomalies.jsonl for replay parity."""
+
+    def opt_spec(p):
+        p.add_argument(
+            "--corpus-dir", default="store/fuzz", metavar="DIR",
+            help="Corpus directory (checkpointed each round; resumes)",
+        )
+        p.add_argument(
+            "--rounds", type=int, default=4, metavar="N",
+            help="Total rounds the corpus should reach (a resumed "
+            "corpus runs only the remainder)",
+        )
+        p.add_argument(
+            "--clusters", type=int, default=256, metavar="N",
+            help="Simulated clusters per round (one device launch)",
+        )
+        p.add_argument(
+            "--seed", type=int, default=0, metavar="N",
+            help="Fuzz seed: the whole run is a pure function of it",
+        )
+        p.add_argument(
+            "--families", default=None, metavar="LIST",
+            help="Comma-separated fault families to draw schedules "
+            "from (default: all six)",
+        )
+        p.add_argument(
+            "--engine", default=None, metavar="NAME",
+            help="Pin the simulator engine (host, tpu); default rides "
+            "the supervised sim ladder with host fallback",
+        )
+        p.add_argument(
+            "--fuzz-nodes", type=int, default=None, metavar="N",
+            help="Simulated nodes per cluster (default 5)",
+        )
+        p.add_argument(
+            "--keys", type=int, default=None, metavar="N",
+            help="Keys per simulated workload (default 8)",
+        )
+        p.add_argument(
+            "--txns", type=int, default=None, metavar="N",
+            help="Transactions per simulated cluster (default 24)",
+        )
+        p.add_argument(
+            "--fault-slots", type=int, default=None, metavar="N",
+            help="Fault slots per schedule (default 8)",
+        )
+
+    def run(opts):
+        import json
+
+        from .fuzz.loop import run_fuzz
+
+        summary = run_fuzz({
+            "corpus_dir": opts["corpus_dir"],
+            "rounds": opts.get("rounds"),
+            "clusters": opts.get("clusters"),
+            "seed": opts.get("seed"),
+            "families": opts.get("families"),
+            "engine": opts.get("engine"),
+            "nodes_n": opts.get("fuzz_nodes"),
+            "keys": opts.get("keys"),
+            "txns": opts.get("txns"),
+            "fault_slots": opts.get("fault_slots"),
+        })
+        print(json.dumps(summary, indent=2, sort_keys=True))
+        return 0
+
+    return {"fuzz": Subcommand(
+        run=run, opt_spec=opt_spec,
+        usage="Coverage-guided fault-schedule fuzzing over batched "
+        "simulated clusters; anomalies accumulate in the corpus for "
+        "replay parity.")}
+
+
 if __name__ == "__main__":  # the reference's jepsen.cli/-main (cli.clj:399-402)
-    main({**serve_cmd(), **doctor_cmd()})
+    main({**serve_cmd(), **doctor_cmd(), **fuzz_cmd()})

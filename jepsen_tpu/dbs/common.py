@@ -577,9 +577,26 @@ def fault_package_wiring(test: dict, db_, opts: dict,
     (nemesis.combined.wire_package). The test map's CURRENT generator
     must be the client-side generator; wiring wraps it. Returns True
     when wired, False when --nemesis is a plain registry name for
-    pick_nemesis."""
+    pick_nemesis.
+
+    --nemesis-schedule FILE takes precedence over --nemesis: the file's
+    schedule document (combined.schedule_to_json / a fuzz-discovered
+    schedule) is replayed VERBATIM through the real nemeses — same
+    wiring, no rng."""
     from ..nemesis import combined
 
+    sched_file = opts.get("nemesis_schedule")
+    if sched_file:
+        pkg = combined.load_schedule_file(
+            sched_file, db=db_, corrupt_paths=corrupt_paths,
+            set_time_fn=set_time_fn)
+        combined.wire_package(test, pkg, {
+            "time_limit": opts.get("time_limit", 60),
+            "stability_period": opts.get("stability_period", 10.0),
+            "stability_generator": stability_generator,
+            "recovery_min_ok": opts.get("recovery_min_ok", 1),
+        })
+        return True
     fams = combined.parse_fault_spec(opts.get("nemesis"))
     if fams is None:
         return False

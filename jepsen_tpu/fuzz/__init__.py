@@ -1,0 +1,76 @@
+"""Million-cluster fault-schedule fuzzing (ROADMAP item 4).
+
+PR 1 made every fault schedule a pure function of ``--seed``; the
+``dbs/*_sim`` suites and workloads/list_append's serial-store
+simulator made whole runs deterministic and cluster-free. This package
+vectorizes that premise: the list-append store simulator and the six
+nemesis-family schedules (nemesis/combined.py) are ported to
+fixed-shape integer array form so ONE device launch executes thousands
+of seeded simulated clusters end-to-end, each under its own fault
+schedule — the repo stops checking given histories and starts
+*generating* scenario diversity at TPU rate.
+
+The pipeline, one module per stage:
+
+schedule.py   the array encoding of a fault schedule — F fault slots of
+              (family, node-mask, window, params) int32 — plus seeded
+              generation (pure function of seed), the mutation operators
+              the fuzz loop applies (shift/widen/overlap windows, splice
+              slots, retarget masks), and the bridge that renders an
+              array schedule as a nemesis/combined.py schedule document
+              so any fuzz-discovered schedule replays through the REAL
+              (non-vectorized) nemesis path via ``--nemesis-schedule``.
+
+sim.py        the vectorized cluster: a batch-first, integer-only
+              simulation of N replicated list-append nodes under the
+              schedule's faults (partition visibility walls, clock
+              skew/strobe reordering commit order, kill windows failing
+              txns and redelivering replication, pause splitting a
+              txn's micro-ops across time, corruption rolling a
+              replica's tail back, packet loss delaying delivery).
+              One implementation runs twice: jitted jax as the device
+              engine, numpy as the host floor — behind a third
+              supervisor singleton (SIM_LADDER: sim_tpu -> sim_host),
+              so a mid-fuzz device failure degrades a round to host
+              and never poisons the corpus. Every read observes a
+              prefix of the final per-key append order by
+              construction, so decoded traces are always inferable
+              (no IllegalInference), and every anomaly found is real.
+
+score.py      trace -> verdict + coverage: decode each cluster's output
+              arrays into a standard invoke/ok history, infer the
+              dependency graph (checker/cycle/deps), and classify Adya
+              anomalies with ALL clusters' component x mask closures
+              batched into ONE supervised launch on the closure ladder.
+              Coverage features: anomaly class set, component/SCC
+              buckets, edge-relation mix, fault-overlap signature.
+
+loop.py       the coverage-guided mutation loop and the corpus: seed
+              schedules + retained mutants keyed by coverage bucket,
+              crash-consistent checkpoints (write-temp -> fsync ->
+              rename, the PR 5 discipline; a SIGKILL'd round replays
+              idempotently from the round counter), and automatic
+              commit of every discovered anomaly trace to the
+              replay-parity corpus (tools/replay_parity.py's ``fuzz``
+              block re-checks them on every engine).
+
+CLI: ``jepsen-tpu fuzz`` (cli.fuzz_cmd). Bench: bench.py's ``fuzz``
+lane (simulated clusters/s, time-to-first-anomaly). Docs:
+ARCHITECTURE.md "Vectorized cluster fuzzing" and
+docs/tutorial/12-fuzzing.md.
+"""
+
+from __future__ import annotations
+
+from .schedule import FAMILIES, SimSpec, random_schedule
+from .sim import simulate_batch
+from .score import decode, score_batch
+
+__all__ = [
+    "FAMILIES",
+    "SimSpec",
+    "decode",
+    "random_schedule",
+    "score_batch",
+    "simulate_batch",
+]

@@ -1,0 +1,398 @@
+"""Vectorized list-append cluster simulator.
+
+One launch simulates a whole batch of independent clusters. Cluster
+``i`` is fully determined by ``(wseeds[i], scheds[i])``: the workload
+(coordinator choice, txn shapes, keys, read/append mix) is a pure
+function of the workload seed via an FNV/murmur-style integer hash,
+and the fault behavior is a pure function of the ``fuzz.schedule``
+array. Everything is fixed-shape int32 tensor math — no data-dependent
+shapes, no floats — so the SAME ``_sim_math`` body runs as jitted jax
+on the device rung and as plain numpy on the host rung, bit-identically
+(the host/device parity test pins this).
+
+The model, in mop-time units (one txn slot = L mop-times):
+
+* Txn slot ``s`` runs on coordinator ``coord[s]`` with up to ``L``
+  micro-ops; mop ``(s, j)`` executes at effective time ``s*L + j``
+  modified by faults. Appended values are globally unique
+  (``vid = s*L + j + 1``).
+* kill — a txn whose coordinator is inside a kill window FAILS (it is
+  excluded from the trace); replication *to* a killed node is
+  redelivered when the window ends.
+* pause — a paused coordinator executes mops ``[0, p0)`` at slot time
+  and defers mops ``[p0, L)`` to the window's end: one txn's effects
+  interleave with seconds of other txns (the G0/G1c genesis).
+* clock — a skewed coordinator's mops commit at ``t + p0 ± strobe``;
+  skew reorders the serial append order across nodes.
+* partition — replication crossing the cut is walled until the window
+  ends; reads on the far side run stale (the G-single/G2 genesis).
+* packet — seeded per-(mop, node) drops with delayed retransmission.
+* corruption — masked replicas lose the recent tail of one key's log
+  at ``t0`` and re-converge just after (bounded rollback).
+
+The final append order per key ranks appends by ``(eff, mop-index)``;
+a read at node ``n`` observes exactly the appends whose *delivery* to
+``n`` precedes it — and its length is computed as the smallest
+position not yet visible, so **every read is a prefix of the final
+order**. Audit read txns run after every window/redelivery can land
+and observe whole logs. Consequently decoded traces are always
+inferable by checker/cycle/deps (no IllegalInference) and every
+anomaly the checker reports is a real consequence of the schedule.
+
+Engines ride a third supervisor singleton (``get_sim()``) with ladder
+``sim_tpu -> sim_host``: a device failure mid-fuzz degrades the round
+to host — with identical results — and never poisons the corpus.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+import numpy as np
+
+from ..checker import supervisor as sup_mod
+from .schedule import (CLOCK, CORRUPT, DEFAULT_SPEC, KILL, PARTITION, PACKET,
+                       PAUSE, SimSpec, canonicalize)
+
+#: sentinel delivery/position for "never" — far beyond any real time
+#: but safely inside int32 even after packet/retry arithmetic.
+_BIG = np.int32(1 << 28)
+
+#: pad / append / read codes in the ``kind`` output array.
+KIND_APPEND = 0
+KIND_READ = 1
+KIND_PAD = 2
+
+SIM_LADDER = ("sim_tpu", "sim_host")
+
+
+def _make_hi(xp, np_mode: bool):
+    """A 4-input integer hash -> uniform non-negative int32 arrays.
+
+    murmur3-style finalizers over 32-bit lanes. The jax path uses
+    native uint32 wraparound; the numpy path computes in uint64 and
+    masks, which is bit-identical (products of 32-bit values never
+    overflow 64 bits) without tripping numpy overflow warnings.
+    """
+    if np_mode:
+        M = np.uint64(0xFFFFFFFF)
+
+        def conv(x):
+            return np.asarray(x).astype(np.uint64)
+
+        def mul(a, c):
+            return (a * np.uint64(c)) & M
+    else:
+        def conv(x):
+            if isinstance(x, int):  # constants: dodge the int32 default
+                return xp.uint32(x & 0xFFFFFFFF)
+            return xp.asarray(x).astype(xp.uint32)
+
+        def mul(a, c):
+            return a * xp.uint32(c)
+
+    def fmix(h):
+        h = h ^ (h >> 16)
+        h = mul(h, 0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = mul(h, 0xC2B2AE35)
+        return h ^ (h >> 16)
+
+    def hi(w, c, a, b):
+        """hash(workload-seed, stream-constant, index-a, index-b) ->
+        int32 in [0, 2^31); broadcasts like its array arguments."""
+        h = fmix(conv(w) ^ conv(0x9E3779B9))
+        h = fmix(h ^ mul(conv(a), 0x85EBCA6B))
+        h = fmix(h ^ mul(conv(b), 0xC2B2AE35))
+        h = fmix(h ^ mul(conv(c), 0x27D4EB2F))
+        return (h & conv(0x7FFFFFFF)).astype(xp.int32)
+
+    return hi
+
+
+def _sim_math(xp, hi, scheds, wseeds, spec: SimSpec) -> dict:
+    """The whole cluster batch, as one fixed-shape tensor program.
+
+    scheds: [S, F, 6] int32, canonical. wseeds: [S] int (any width).
+    Returns batch-first int32/bool arrays; see ``simulate_batch``.
+    """
+    S = scheds.shape[0]
+    F, T, St, L = spec.faults, spec.txns, spec.slots, spec.mops
+    N, K = spec.nodes, spec.keys
+    i32 = xp.int32
+    sarr = xp.arange(St, dtype=i32)                       # [St]
+    jarr = xp.arange(L, dtype=i32)                        # [L]
+    w2 = xp.asarray(wseeds).astype(i32)[:, None]          # [S,1]
+    w3 = w2[:, :, None]                                   # [S,1,1]
+
+    # -- workload: pure function of the workload seed ------------------
+    is_audit = sarr >= T                                  # [St]
+    coord = xp.where(is_audit, 0, hi(w2, 11, sarr, 0) % N)
+    nmops = xp.where(is_audit, L, 1 + hi(w2, 12, sarr, 0) % L)
+    rd = hi(w3, 13, sarr[None, :, None], jarr) % 2        # [S,St,L]
+    key = hi(w3, 14, sarr[None, :, None], jarr) % K
+    akey = (sarr[:, None] - T) * L + jarr[None, :]        # [St,L]
+    active = xp.where(is_audit[:, None], akey < K,
+                      jarr[None, :] < nmops[:, :, None])
+    key = xp.where(is_audit[:, None], xp.clip(akey, 0, K - 1), key)
+    kind = xp.where(~active, KIND_PAD,
+                    xp.where(is_audit[:, None] | (rd == 1),
+                             KIND_READ, KIND_APPEND))     # [S,St,L]
+
+    # -- fault coverage at each txn's coordinator ----------------------
+    fam, msk = scheds[:, :, 0], scheds[:, :, 1]           # [S,F]
+    t0, t1 = scheds[:, :, 2], scheds[:, :, 3]
+    p0, p1 = scheds[:, :, 4], scheds[:, :, 5]
+    cbit = ((msk[:, :, None] >> coord[:, None, :]) & 1) == 1
+    win = (t0[:, :, None] <= sarr) & (sarr < t1[:, :, None]) & ~is_audit
+    cwin = cbit & win                                     # [S,F,St]
+    failed = xp.any((fam[:, :, None] == KILL) & cwin, axis=1)
+    pc = (fam[:, :, None] == PAUSE) & cwin
+    pend = xp.max(xp.where(pc, t1[:, :, None], 0), axis=1)
+    psplit = xp.max(xp.where(pc, p0[:, :, None], 0), axis=1)
+    paused = xp.any(pc, axis=1)                           # [S,St]
+    cc = (fam[:, :, None] == CLOCK) & cwin
+    coff = xp.sum(xp.where(cc, p0[:, :, None], 0), axis=1)
+    camp = xp.max(xp.where(cc, p1[:, :, None], 0), axis=1)
+
+    # -- effective (commit-order) time of every mop --------------------
+    base = sarr[None, :, None] * L + jarr                 # [1,St,L]
+    defer = paused[:, :, None] & (jarr[None, None, :] >= psplit[:, :, None])
+    basew = xp.where(defer, pend[:, :, None] * L + jarr, base)
+    denom = 2 * camp[:, :, None] + 1
+    jit_ = hi(w3, 16, sarr[None, :, None], jarr) % denom - camp[:, :, None]
+    effw = xp.maximum(basew + coff[:, :, None] + jit_, 0)
+    abase = (spec.audit_t0 + sarr[None, :, None] - T) * L + jarr
+    eff = xp.where(is_audit[None, :, None], abase, effw)  # [S,St,L]
+
+    # -- flatten to mop index m = s*L + j ------------------------------
+    Mtot = St * L
+    marr = xp.arange(Mtot, dtype=i32)
+    effm = eff.reshape(S, Mtot)
+    keym = key.reshape(S, Mtot)
+    kindm = kind.reshape(S, Mtot)
+    sendm = xp.broadcast_to(coord[:, :, None], (S, St, L)).reshape(S, Mtot)
+    failm = xp.broadcast_to(failed[:, :, None], (S, St, L)).reshape(S, Mtot)
+    vapp = (kindm == KIND_APPEND) & ~failm
+    vread = (kindm == KIND_READ) & ~failm
+
+    # -- final per-key append order: rank by (eff, mop index) ----------
+    keyeq = keym[:, :, None] == keym[:, None, :]          # [S,M,M']
+    earlier = (effm[:, None, :] < effm[:, :, None]) \
+        | ((effm[:, None, :] == effm[:, :, None])
+           & (marr[None, :] < marr[:, None]))
+    pos = xp.sum(vapp[:, None, :] & keyeq & earlier, axis=2).astype(i32)
+
+    # -- delivery time of each append at each node ---------------------
+    narr = xp.arange(N, dtype=i32)
+    deliv = effm[:, :, None] + xp.zeros((1, 1, N), dtype=i32)
+    for f in range(F):  # static unroll; one family per slot
+        fa = fam[:, f][:, None, None]
+        mk = msk[:, f][:, None, None]
+        a0 = t0[:, f][:, None, None] * L
+        a1 = t1[:, f][:, None, None] * L
+        q0 = p0[:, f][:, None, None]
+        q1 = p1[:, f][:, None, None]
+        sb = ((mk >> sendm[:, :, None]) & 1) == 1         # [S,M,1]
+        rb = ((mk >> narr[None, None, :]) & 1) == 1       # [S,1,N]
+        nonlocal_ = sendm[:, :, None] != narr[None, None, :]
+        # windows test the CURRENT delivery time, so faults cascade
+        # (a partition can push a delivery into a kill window) in a
+        # fixed slot order — deterministic on both engines.
+        inw = (a0 <= deliv) & (deliv < a1)
+        deliv = xp.where((fa == PARTITION) & (sb ^ rb) & inw, a1, deliv)
+        hd = hi(w3, 170 + f, marr[None, :, None], narr[None, None, :])
+        inw = (a0 <= deliv) & (deliv < a1)
+        drop = (fa == PACKET) & (sb | rb) & nonlocal_ & inw \
+            & (hd % 16 < q0)
+        extra = 1 + (hd >> 4) % xp.maximum(q1 * L, 1)
+        deliv = xp.where(drop, deliv + extra, deliv)
+        inw = (a0 <= deliv) & (deliv < a1)
+        deliv = xp.where((fa == KILL) & rb & inw, a1, deliv)
+        inw = (a0 <= deliv) & (deliv < a1)
+        deliv = xp.where((fa == PAUSE) & rb & inw, a1, deliv)
+        roll = (fa == CORRUPT) & rb & (keym[:, :, None] == q0) \
+            & (a0 - q1 * L <= deliv) & (deliv < a0)
+        deliv = xp.where(roll, a0 + 1, deliv)
+    local = narr[None, None, :] == sendm[:, :, None]
+    deliv = xp.where(local, effm[:, :, None], deliv)      # own node: instant
+    deliv = xp.where(vapp[:, :, None], deliv, _BIG)
+
+    # -- reads: longest not-yet-visible position bounds the prefix -----
+    deliv_t = xp.transpose(deliv, (0, 2, 1))              # [S,N,M']
+    dsel = xp.take_along_axis(deliv_t, sendm[:, :, None], axis=1)
+    e_r = effm[:, :, None]
+    vis = (dsel < e_r) | ((dsel == e_r) & (marr[None, :] < marr[:, None]))
+    inv = vapp[:, None, :] & keyeq & ~vis
+    minpos = xp.min(xp.where(inv, pos[:, None, :], _BIG), axis=2)
+    total = xp.sum(vapp[:, None, :] & keyeq, axis=2).astype(i32)
+    rlen = xp.minimum(minpos, total)
+
+    return {
+        "coord": coord.astype(i32),
+        "failed": failm.reshape(S, St, L)[:, :, 0],
+        "kind": kindm.reshape(S, St, L),
+        "key": keym.reshape(S, St, L),
+        "eff": effm.reshape(S, St, L),
+        "pos": xp.where(vapp, pos, -1).reshape(S, St, L),
+        "rlen": xp.where(vread, rlen, -1).reshape(S, St, L),
+    }
+
+
+def _as_batch(scheds, wseeds, spec: SimSpec):
+    scheds = np.asarray(scheds, dtype=np.int32)
+    if scheds.ndim == 2:
+        scheds = scheds[None]
+    if scheds.shape[1:] != (spec.faults, 6):
+        raise ValueError(f"schedule batch shape {scheds.shape}")
+    wseeds = np.atleast_1d(np.asarray(wseeds, dtype=np.int64))
+    if wseeds.shape[0] != scheds.shape[0]:
+        raise ValueError("wseeds/scheds batch mismatch")
+    # fold to non-negative 31-bit — the hash's seed lane width
+    wseeds = (wseeds & 0x7FFFFFFF).astype(np.int32)
+    return scheds, wseeds
+
+
+def sim_host(scheds, wseeds, spec: SimSpec = DEFAULT_SPEC) -> dict:
+    """Numpy floor engine: one call, whole batch, no dependencies."""
+    scheds, wseeds = _as_batch(scheds, wseeds, spec)
+    hi = _make_hi(np, np_mode=True)
+    out = _sim_math(np, hi, scheds, wseeds, spec)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted(spec: SimSpec):
+    import jax
+    import jax.numpy as jnp
+
+    hi = _make_hi(jnp, np_mode=False)
+
+    def f(scheds, wseeds):
+        return _sim_math(jnp, hi, scheds, wseeds, spec)
+
+    return jax.jit(f)
+
+
+def sim_device(scheds, wseeds, spec: SimSpec = DEFAULT_SPEC) -> dict:
+    """Jitted jax engine: ONE device launch executes the whole batch
+    of seeded clusters end-to-end."""
+    import jax
+
+    scheds, wseeds = _as_batch(scheds, wseeds, spec)
+    out = _jitted(spec)(scheds, wseeds)
+    out = jax.device_get(out)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def probe(spec: SimSpec = DEFAULT_SPEC) -> bool:
+    """Can the device engine compile at all? (supervisor probe hook)"""
+    try:
+        sim_device(np.zeros((1, spec.faults, 6), np.int32), [0], spec)
+        return True
+    except Exception:  # noqa: BLE001 — any failure means "no"
+        return False
+
+
+# -- supervision --------------------------------------------------------
+#
+# Third supervisor singleton (after the search-engine and closure
+# ones): the work unit is a list of (wseed, schedule) cluster configs
+# and `model` carries the SimSpec. Rung names are distinct so breaker
+# state and telemetry never collide with the other ladders.
+
+def _split(out: dict, n: int) -> list:
+    return [{k: np.asarray(v[i]) for k, v in out.items()} for i in range(n)]
+
+
+def _stack(model, ess):
+    spec = model or DEFAULT_SPEC
+    scheds = np.stack([np.asarray(e[1], dtype=np.int32) for e in ess])
+    wseeds = np.array([int(e[0]) for e in ess], dtype=np.int64)
+    return spec, scheds, wseeds
+
+
+def _run_sim_tpu(model, ess, max_steps=None, time_limit=None):
+    spec, scheds, wseeds = _stack(model, ess)
+    return _split(sim_device(scheds, wseeds, spec), len(ess))
+
+
+def _run_sim_host(model, ess, max_steps=None, time_limit=None):
+    spec, scheds, wseeds = _stack(model, ess)
+    return _split(sim_host(scheds, wseeds, spec), len(ess))
+
+
+def _elig_sim_tpu(model, ess) -> bool:
+    try:
+        import jax  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def sim_registry() -> dict:
+    return {"sim_tpu": _run_sim_tpu, "sim_host": _run_sim_host}
+
+
+def sim_eligibility() -> dict:
+    return {"sim_tpu": _elig_sim_tpu,
+            "sim_host": lambda model, ess: True}
+
+
+_sim_sup: sup_mod.Supervisor | None = None
+_sim_lock = threading.Lock()
+
+
+def get_sim() -> sup_mod.Supervisor:
+    """The per-process sim supervisor (same config env knobs as the
+    checker's, its own registry/breaker/telemetry)."""
+    global _sim_sup
+    with _sim_lock:
+        if _sim_sup is None:
+            _sim_sup = sup_mod.Supervisor(
+                sup_mod._env_config(), registry=sim_registry(),
+                eligibility=sim_eligibility())
+        return _sim_sup
+
+
+def _reset_sim_for_tests(sup: sup_mod.Supervisor | None = None) -> None:
+    global _sim_sup
+    with _sim_lock:
+        _sim_sup = sup
+
+
+def simulate_batch(scheds, wseeds, spec: SimSpec = DEFAULT_SPEC,
+                   engine: str | None = None,
+                   deadline: float | None = None) -> list:
+    """Simulate a batch of clusters; returns one result dict per
+    cluster (int32/bool numpy arrays):
+
+    coord [slots], failed [slots], kind/key/eff/pos/rlen [slots, mops].
+
+    engine=None rides the supervised SIM_LADDER (device, host floor —
+    a device failure degrades the batch, never aborts it); "host" /
+    "tpu" pin a rung, bypassing supervision (tests, parity runs).
+    """
+    scheds = np.asarray(scheds, dtype=np.int32)
+    if scheds.ndim == 2:
+        scheds = scheds[None]
+    scheds = np.stack([canonicalize(s, spec) for s in scheds])
+    wseeds = np.atleast_1d(np.asarray(wseeds, dtype=np.int64))
+    if engine == "host":
+        return _split(sim_host(scheds, wseeds, spec), scheds.shape[0])
+    if engine in ("tpu", "device", "sim_tpu"):
+        return _split(sim_device(scheds, wseeds, spec), scheds.shape[0])
+    if engine is not None:
+        raise ValueError(f"unknown sim engine: {engine}")
+    ess = list(zip(wseeds.tolist(), list(scheds)))
+    return get_sim().run(spec, ess, ladder=SIM_LADDER, deadline=deadline,
+                         on_exhausted="raise")
+
+
+#: JEPSEN_TPU_SIM_ENGINE pins the fuzz loop's sim rung (mirrors the
+#: checker's engine pinning envs; used by the chaos driver to keep
+#: SIGKILL-resume rounds byte-reproducible without jax warmup cost).
+def env_engine() -> str | None:
+    return os.environ.get("JEPSEN_TPU_SIM_ENGINE") or None

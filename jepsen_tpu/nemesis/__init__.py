@@ -308,16 +308,25 @@ class ClockScrambler(Nemesis):
             return op.with_(type="info", value="clocks reset")
 
         dt = self.dt
-        # draw every offset up front, under one lock-free pass, so a
-        # seeded rng yields the same schedule regardless of on_nodes's
-        # thread interleaving
-        offsets = {node: self.rng.uniform(-dt, dt)
-                   for node in test["nodes"]}
+        if isinstance(op.value, Mapping):
+            # value-driven (like Partitioner/ProcessNemesis): the
+            # seeded generator precomputed per-node offsets, so the
+            # schedule is self-describing and replayable from JSON
+            offsets = {node: float(op.value[node])
+                       for node in test["nodes"] if node in op.value}
+        else:
+            # draw every offset up front, under one lock-free pass, so
+            # a seeded rng yields the same schedule regardless of
+            # on_nodes's thread interleaving
+            offsets = {node: self.rng.uniform(-dt, dt)
+                       for node in test["nodes"]}
 
         def scramble(t, node):
             # uniform over [-dt, dt); randrange would TypeError on a
-            # float dt (the reference's rand-int coerces doubles)
-            self._set(test, node, _time.time() + offsets[node])
+            # float dt (the reference's rand-int coerces doubles).
+            # Nodes outside a value-driven offset map keep true time.
+            if node in offsets:
+                self._set(test, node, _time.time() + offsets[node])
 
         self._scrambled = True
         return op.with_(value=on_nodes(test, scramble))

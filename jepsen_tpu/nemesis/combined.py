@@ -196,12 +196,24 @@ def _shuffled_halves(nodes, rng):
 def clock_package(opts: dict) -> NemesisPackage:
     """Clock skew faults (nemesis/combined.clj clock-package): scramble
     node clocks within ±clock_dt seconds, reset on heal. set_time_fn is
-    injectable for sandboxes where `date -s` can't run."""
+    injectable for sandboxes where `date -s` can't run.
+
+    The generator precomputes the per-node offsets into op.value
+    (value-driven, like Partitioner/ProcessNemesis) so the schedule is
+    a pure function of the seed and replays from schedule JSON; the
+    ClockScrambler applies a Mapping value verbatim."""
     rng = opts["rng"]
     interval = opts.get("interval", 10.0)
+    dt = opts.get("clock_dt", 60.0)
     scrambler = ClockScrambler(
-        dt=opts.get("clock_dt", 60.0), rng=rng,
-        set_time_fn=opts.get("set_time_fn"))
+        dt=dt, rng=rng, set_time_fn=opts.get("set_time_fn"))
+
+    def scramble(test, process):
+        # rounded so the JSON rendering is byte-stable across platforms
+        return {"type": "info", "f": "scramble-clock",
+                "value": {node: round(rng.uniform(-dt, dt), 6)
+                          for node in test["nodes"]}}
+
     nemesis = compose({
         _FDict({"scramble-clock": "scramble", "reset-clock": "reset"}):
             scrambler,
@@ -209,7 +221,7 @@ def clock_package(opts: dict) -> NemesisPackage:
     return NemesisPackage(
         nemesis=nemesis,
         generator=_alternator(
-            {"type": "info", "f": "scramble-clock"},
+            scramble,
             {"type": "info", "f": "reset-clock"}, interval),
         final_generator=gen_mod.once({"type": "info", "f": "reset-clock"}),
         fs=frozenset({"scramble-clock", "reset-clock"}),
@@ -366,12 +378,25 @@ class FileCorruptor(Nemesis):
                      path],
                     sudo=True)
             elif kind == "bitflip":
-                test["remote"].exec(
-                    node,
-                    ["dd", "if=/dev/urandom", f"of={path}", "bs=1",
-                     "count=1", f"seek={spec.get('offset', 0)}",
-                     "conv=notrunc"],
-                    sudo=True)
+                # the corrupting byte is drawn by the SEEDED generator
+                # and rides in the spec; /dev/urandom remains only as
+                # the fallback for legacy specs without one
+                if "byte" in spec:
+                    b = int(spec["byte"]) & 0xFF
+                    test["remote"].exec(
+                        node,
+                        ["sh", "-c",
+                         f"printf '\\x{b:02x}' | dd of={path} bs=1 "
+                         f"count=1 seek={spec.get('offset', 0)} "
+                         "conv=notrunc"],
+                        sudo=True)
+                else:
+                    test["remote"].exec(
+                        node,
+                        ["dd", "if=/dev/urandom", f"of={path}", "bs=1",
+                         "count=1", f"seek={spec.get('offset', 0)}",
+                         "conv=notrunc"],
+                        sudo=True)
             else:
                 raise ValueError(f"unknown corruption kind {kind!r}")
             results[node] = f"{kind} {path}"
@@ -401,7 +426,11 @@ def file_corruption_package(opts: dict) -> NemesisPackage:
         if kind == "truncate":
             spec["bytes"] = rng.randrange(1, 65)
         else:
+            # offset AND replacement byte both come from the seeded
+            # rng — the fault content, not just its location, is a
+            # pure function of the seed (FileCorruptor applies it)
             spec["offset"] = rng.randrange(64)
+            spec["byte"] = rng.randrange(256)
         return {"type": "info", "f": "corrupt-file", "value": [spec]}
 
     return NemesisPackage(
@@ -628,3 +657,259 @@ def wire_package(test: dict, package: NemesisPackage,
         compose_checkers({"workload": base, "recovery": rc})
         if base is not None else rc)
     return test
+
+
+# ---------------------------------------------------------------------------
+# Schedule (de)serialization
+#
+# A *schedule document* is the full materialized fault schedule of a
+# composed package — every op the seeded generators would emit, with
+# values precomputed (grudges, clock offsets, kill targets, corruption
+# specs) — as plain JSON. Because every builder is value-driven, the
+# document captures the schedule completely: replaying it through
+# schedule_from_json drives the SAME nemeses through the SAME ops
+# without consulting an rng. This is how fuzz-discovered schedules
+# (fuzz/schedule.to_nemesis_doc emits the same shape) reach the real
+# nemesis path via `jepsen-tpu test --nemesis-schedule <file>`.
+
+#: family -> (fault fs, heal fs); the static side of the builders
+FAMILY_FS = {
+    "partition": ({"start-partition"}, {"stop-partition"}),
+    "clock": ({"scramble-clock"}, {"reset-clock"}),
+    "kill": ({"kill"}, {"restart"}),
+    "pause": ({"pause"}, {"resume"}),
+    "corruption": ({"corrupt-file"}, set()),
+    "packet": ({"packet-start"}, {"packet-stop"}),
+}
+
+#: op f -> family, derived
+_F_FAMILY = {f: fam for fam, (fs, hs) in FAMILY_FS.items()
+             for f in (fs | hs)}
+
+
+class _ScheduleDB(db_mod.DB, db_mod.Kill, db_mod.Pause):
+    """Inert DB satisfying the kill/pause protocols, used when a
+    package is built only to MATERIALIZE its schedule (no cluster)."""
+
+    def alive(self, test, node):
+        return True
+
+    def kill(self, test, node):
+        pass
+
+    def start(self, test, node):
+        pass
+
+    def pause(self, test, node):
+        pass
+
+    def resume(self, test, node):
+        pass
+
+
+def _default_nodes(opts: dict) -> list:
+    nodes = opts.get("nodes")
+    if nodes:
+        return list(nodes)
+    return [f"n{i + 1}" for i in range(5)]
+
+
+def _json_value(v):
+    """Op values, coerced to canonical JSON-pure form (sets -> sorted
+    lists; mappings key-sorted) so document bytes are process-stable."""
+    import json as _json
+
+    return _json.loads(_json.dumps(v, sort_keys=True, default=sorted))
+
+
+def materialize_schedule(opts: dict | None = None, **kw) -> dict:
+    """Step a freshly built composed package's generators to the end
+    and record every op as a schedule document:
+
+      {"version": 1, "faults": [...], "nodes": [...], "interval": s,
+       "seed": n, "fault_ops": n,
+       "events": [{"dt": s, "f": ..., "value": ...}, ...],
+       "final":  [...]}
+
+    Takes the same options as nemesis_package; `fault_ops` (default
+    16) bounds the schedule, `interval` (default 10) is recorded as
+    each main event's pacing delay but NOT slept here — the package is
+    built unpaced, so materialization is instant. kill/pause families
+    fall back to an inert protocol-satisfying DB when opts lacks one
+    (materialization never touches a cluster)."""
+    opts = {**(opts or {}), **kw}
+    nodes = _default_nodes(opts)
+    interval = opts.get("interval", 10.0)
+    fault_ops = opts.get("fault_ops") or 16
+    faults = list(opts.get("faults") or ("partition",))
+    if opts.get("db") is None and ({"kill", "pause"} & set(faults)):
+        opts["db"] = _ScheduleDB()
+    if "corruption" in faults and not opts.get("corrupt_paths"):
+        # placeholder path: replay (schedule_from_json) re-targets
+        # corruption specs at the caller's corrupt_paths
+        opts["corrupt_paths"] = [None]
+    pkg = nemesis_package({**opts, "interval": 0,
+                           "fault_ops": fault_ops})
+    test = {"nodes": nodes, "db": opts.get("db")}
+
+    def _steps(g, dt):
+        out = []
+        while g is not None:
+            o = gen_mod.op(g, test, "nemesis")
+            if o is None:
+                break
+            out.append({"dt": dt, "f": o["f"],
+                        "value": _json_value(o.get("value"))})
+        return out
+
+    events = _steps(pkg.generator, interval)
+    final = [dict(e, dt=0) for e in _steps(pkg.final_generator, 0)]
+    ordered = [f for f in FAULT_FAMILIES if f in set(faults)]
+    return {"version": 1, "faults": ordered, "nodes": nodes,
+            "interval": interval, "seed": opts.get("seed"),
+            "fault_ops": fault_ops, "events": events, "final": final}
+
+
+def schedule_to_json(source=None, **kw) -> str:
+    """Canonical JSON of a fault schedule. `source` may be a schedule
+    document (from materialize_schedule / fuzz.schedule.to_nemesis_doc),
+    a NemesisPackage built by schedule_from_json (its document rides on
+    .schedule_doc), or option kwargs for a fresh seeded package. Same
+    options + same seed => byte-identical string (the determinism
+    property test pins this)."""
+    import json as _json
+
+    if isinstance(source, NemesisPackage):
+        doc = getattr(source, "schedule_doc", None)
+        if doc is None:
+            raise ValueError(
+                "package has no schedule document; only packages from "
+                "schedule_from_json carry one — pass builder options "
+                "instead")
+    elif isinstance(source, Mapping) and "events" in source:
+        doc = source
+    else:
+        doc = materialize_schedule(source, **kw)
+    return _json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+class _DocEvents(gen_mod.Generator):
+    """Generator replaying literal schedule-document events. Each
+    event's `dt` is slept before the op is emitted (the DelayFn
+    discipline), so relative fault timing survives the JSON
+    round-trip; pace=False skips the sleeps (dry replay, tests).
+    Corruption specs with a null path are re-targeted at the provided
+    corrupt_paths cycle."""
+
+    def __init__(self, events, corrupt_paths=None, pace=True):
+        self._events = list(events or [])
+        self._paths = list(corrupt_paths or [])
+        self._pace = pace
+        self._i = 0
+
+    def op(self, test, process):
+        import time as _time
+
+        if self._i >= len(self._events):
+            return None
+        e = self._events[self._i]
+        self._i += 1
+        dt = e.get("dt") or 0
+        if dt and self._pace:
+            _time.sleep(dt)
+        value = e.get("value")
+        if e["f"] == "corrupt-file" and self._paths:
+            value = [dict(spec, path=spec.get("path")
+                          or self._paths[i % len(self._paths)])
+                     for i, spec in enumerate(value or [])]
+        return {"type": e.get("type", "info"), "f": e["f"],
+                "value": value}
+
+
+def schedule_from_json(data, opts: dict | None = None,
+                       **kw) -> NemesisPackage:
+    """Rebuild a NemesisPackage from a schedule document (dict or JSON
+    string): the real nemeses for every family the document touches,
+    driven by generators that replay the recorded events verbatim —
+    no rng anywhere. opts supplies the live-cluster dependencies the
+    document can't carry: `db` (required for kill/pause families),
+    `set_time_fn`, `corrupt_paths`, `clock_dt`; `pace=False` replays
+    without sleeping the recorded inter-event delays.
+
+    The document rides on the returned package as `.schedule_doc`, so
+    schedule_to_json(schedule_from_json(s)) == s byte-identically."""
+    import json as _json
+
+    opts = {**(opts or {}), **kw}
+    doc = _json.loads(data) if isinstance(data, str) else dict(data)
+    if doc.get("version") != 1:
+        raise ValueError(f"unsupported schedule version "
+                         f"{doc.get('version')!r}")
+    families = [f for f in FAULT_FAMILIES if f in set(doc.get("faults")
+                                                      or ())]
+    # families can also be implied by events (hand-written docs)
+    seen = {_F_FAMILY[e["f"]] for e in (doc.get("events") or [])
+            if e.get("f") in _F_FAMILY}
+    families = [f for f in FAULT_FAMILIES if f in (set(families) | seen)]
+    if not families:
+        raise ValueError("schedule document has no fault families")
+    db = opts.get("db")
+    routes: dict = {}
+    fams: dict = {}
+    for fam in families:
+        fs, hs = FAMILY_FS[fam]
+        if fam == "partition":
+            nem = compose({
+                _FDict({"start-partition": "start",
+                        "stop-partition": "stop"}):
+                    Partitioner(lambda nodes: complete_grudge(
+                        bisect(nodes))),
+            })
+        elif fam == "clock":
+            nem = compose({
+                _FDict({"scramble-clock": "scramble",
+                        "reset-clock": "reset"}):
+                    ClockScrambler(dt=opts.get("clock_dt", 60.0),
+                                   set_time_fn=opts.get("set_time_fn")),
+            })
+        elif fam in ("kill", "pause"):
+            proto = db_mod.Kill if fam == "kill" else db_mod.Pause
+            if not isinstance(db, proto):
+                raise ValueError(
+                    f"replaying a schedule with {fam!r} faults needs "
+                    f"opts['db'] implementing db.{proto.__name__}")
+            nem = ProcessNemesis(db, fam)
+        elif fam == "corruption":
+            nem = FileCorruptor()
+        else:  # packet
+            nem = PacketNemesis()
+        routes[frozenset(fs | hs)] = nem
+        fams[fam] = {"faults": set(fs), "heals": set(hs)}
+    paths = opts.get("corrupt_paths")
+    pace = opts.get("pace", True)
+    pkg = NemesisPackage(
+        nemesis=compose(routes),
+        generator=_DocEvents(doc.get("events"), corrupt_paths=paths,
+                             pace=pace),
+        final_generator=(_DocEvents(doc.get("final"),
+                                    corrupt_paths=paths, pace=pace)
+                         if doc.get("final") else None),
+        fs=frozenset(_F_FAMILY) if len(families) == len(FAMILY_FS)
+        else frozenset(f for fam in families
+                       for f in (FAMILY_FS[fam][0] | FAMILY_FS[fam][1])),
+        families=fams,
+        perf={"nemeses": [{"name": fam,
+                           "start": set(FAMILY_FS[fam][0]),
+                           "stop": set(FAMILY_FS[fam][1])}
+                          for fam in families]},
+    )
+    pkg.schedule_doc = doc
+    return pkg
+
+
+def load_schedule_file(path: str, opts: dict | None = None,
+                       **kw) -> NemesisPackage:
+    """schedule_from_json over a file path (the --nemesis-schedule
+    CLI flag's loader)."""
+    with open(path) as fh:
+        return schedule_from_json(fh.read(), opts, **kw)

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Regenerate fuzz_anomalies.jsonl — the committed discovered-anomaly
+corpus that tools/replay_parity.py's "fuzz" block replays through the
+standard cycle checker on every engine.
+
+The corpus is a real fuzz run, not hand-written: a fixed-seed
+FuzzLoop on the host engine, trimmed to the first few discoveries of
+each anomaly class so replay stays fast while every class (G0, G1c,
+G-single, G2) keeps at least one committed witness.
+
+    python tests/fixtures/generate_fuzz_corpus.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from jepsen_tpu.fuzz.loop import FuzzLoop  # noqa: E402
+
+SEED = 0
+ROUNDS = 3
+CLUSTERS = 64
+PER_CLASS = 3
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "fuzz_anomalies.jsonl")
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as tmp:
+        loop = FuzzLoop(tmp, seed=SEED, clusters=CLUSTERS, engine="host")
+        summary = loop.run(rounds=ROUNDS)
+        assert summary["anomaly-types"] == ["G-single", "G0", "G1c", "G2"], (
+            "fixture run must discover all four classes; got "
+            f"{summary['anomaly-types']}")
+        kept, quota = [], {}
+        with open(os.path.join(tmp, "anomalies.jsonl")) as fh:
+            for line in fh:
+                e = json.loads(line)
+                if min((quota.get(t, 0) for t in e["types"]),
+                       default=PER_CLASS) >= PER_CLASS:
+                    continue
+                for t in e["types"]:
+                    quota[t] = quota.get(t, 0) + 1
+                kept.append(line)
+    with open(OUT, "w") as fh:
+        fh.writelines(kept)
+    print(f"{OUT}: {len(kept)} entries, per-class counts {quota}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

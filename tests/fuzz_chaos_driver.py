@@ -1,0 +1,48 @@
+"""Deterministic fuzz-loop driver for the SIGKILL → resume chaos test
+(tests/test_fuzz_chaos.py). Runnable as a subprocess:
+
+    python -m tests.fuzz_chaos_driver <corpus-dir>
+
+With JEPSEN_TPU_FUZZ_KILL set, the driver SIGKILLs itself from the
+loop's round hook during round 1 — after the round's results are
+folded into in-memory state but BEFORE the atomic commit, the widest
+window a crash can tear. A fresh driver run over the same corpus dir
+must then converge to the exact corpus an uninterrupted run produces
+(rounds are pure functions of seed + committed state, so the torn
+round replays idempotently)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+
+from jepsen_tpu.fuzz.loop import FuzzLoop
+
+KILL_ENV = "JEPSEN_TPU_FUZZ_KILL"
+SEED = 9
+ROUNDS = 3
+CLUSTERS = 32
+KILL_ROUND = 1
+
+
+def build_loop(corpus_dir: str) -> FuzzLoop:
+    def hook(rnd):
+        if rnd == KILL_ROUND and os.environ.get(KILL_ENV):
+            # mid-round: results folded, commit not yet written
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    return FuzzLoop(corpus_dir, seed=SEED, clusters=CLUSTERS,
+                    engine="host", round_hook=hook)
+
+
+def main(argv) -> int:
+    corpus_dir = argv[0]
+    summary = build_loop(corpus_dir).run(rounds=ROUNDS)
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

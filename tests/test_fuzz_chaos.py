@@ -1,0 +1,91 @@
+"""Fuzz-corpus crash consistency (chaos): SIGKILL the fuzz loop
+mid-round — results folded, commit not yet durable — restart it, and
+require the corpus to converge byte-identically to an uninterrupted
+run. Exactly-once semantics by idempotent round replay, riding the
+write-temp → fsync → rename discipline (store.atomic_write_json)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from tests import fuzz_chaos_driver as driver
+
+pytestmark = [pytest.mark.chaos, pytest.mark.fuzz]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_driver(corpus_dir: str, kill: bool):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    if kill:
+        env[driver.KILL_ENV] = "1"
+    else:
+        env.pop(driver.KILL_ENV, None)
+    return subprocess.run(
+        [sys.executable, "-m", "tests.fuzz_chaos_driver", corpus_dir],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+
+
+def _corpus(corpus_dir: str) -> dict:
+    with open(os.path.join(corpus_dir, "corpus.json")) as fh:
+        return json.load(fh)
+
+
+def _anomalies(corpus_dir: str) -> str:
+    p = os.path.join(corpus_dir, "anomalies.jsonl")
+    with open(p) as fh:
+        return fh.read()
+
+
+def test_sigkill_midround_resumes_exactly_once(tmp_path):
+    straight = str(tmp_path / "straight")
+    killed = str(tmp_path / "killed")
+
+    # uninterrupted reference run
+    ref = _run_driver(straight, kill=False)
+    assert ref.returncode == 0, ref.stderr
+
+    # killed run: dies by SIGKILL inside round 1, before that round's
+    # commit — only round 0 is durable
+    k = _run_driver(killed, kill=True)
+    assert k.returncode == -signal.SIGKILL, (k.returncode, k.stderr)
+    torn = _corpus(killed)
+    assert torn["round"] == driver.KILL_ROUND, (
+        "the interrupted round must not be committed")
+
+    # restart: replays round 1 idempotently, finishes round 2
+    r = _run_driver(killed, kill=False)
+    assert r.returncode == 0, r.stderr
+
+    a = json.dumps(_corpus(straight), sort_keys=True)
+    b = json.dumps(_corpus(killed), sort_keys=True)
+    assert a == b, "resumed corpus diverged from the uninterrupted run"
+    assert _anomalies(straight) == _anomalies(killed)
+    assert _corpus(killed)["round"] == driver.ROUNDS
+
+
+def test_commit_tear_between_jsonl_and_state(tmp_path):
+    """The narrower tear: anomalies.jsonl rewritten for round N but
+    corpus.json still at round N-1 (a kill between the two writes in
+    Corpus.commit). The next run must repair the jsonl from
+    authoritative state."""
+    d = str(tmp_path / "c")
+    ref = _run_driver(d, kill=False)
+    assert ref.returncode == 0, ref.stderr
+    want_state = json.dumps(_corpus(d), sort_keys=True)
+    want_jsonl = _anomalies(d)
+
+    # simulate the torn commit: roll corpus.json back to its .prev
+    # (the pre-final-round state) while anomalies.jsonl stays new
+    os.replace(os.path.join(d, "corpus.json.prev"),
+               os.path.join(d, "corpus.json"))
+    r = _run_driver(d, kill=False)
+    assert r.returncode == 0, r.stderr
+    assert json.dumps(_corpus(d), sort_keys=True) == want_state
+    assert _anomalies(d) == want_jsonl

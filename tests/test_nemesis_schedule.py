@@ -1,0 +1,195 @@
+"""Fault-schedule (de)serialization and the seeded-determinism audit.
+
+Two properties pin the contract:
+
+  round-trip  schedule_to_json(schedule_from_json(s)) == s for every
+              fault family (and all six composed), byte-identically.
+  determinism every NemesisPackage schedule is a pure function of its
+              seed: same options + same seed => byte-identical
+              schedule_to_json, including the corruption family's
+              replacement bytes and the clock family's per-node
+              offsets (both historically drawn outside the seeded
+              rng), across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import generator as gen_mod
+from jepsen_tpu.nemesis import combined as comb
+
+ALL = list(comb.FAULT_FAMILIES)
+
+
+def _opts(faults, seed=11, **kw):
+    return {"faults": list(faults), "seed": seed, "fault_ops": 8,
+            "interval": 4.0, "corrupt_paths": ["/var/lib/db/wal"], **kw}
+
+
+# ---------------------------------------------------------------------------
+# Round trip
+
+@pytest.mark.parametrize("fam", ALL)
+def test_roundtrip_single_family(fam):
+    s = comb.schedule_to_json(_opts([fam]))
+    pkg = comb.schedule_from_json(s, db=comb._ScheduleDB(),
+                                  corrupt_paths=["/var/lib/db/wal"],
+                                  pace=False)
+    assert comb.schedule_to_json(pkg) == s
+    doc = json.loads(s)
+    fs, hs = comb.FAMILY_FS[fam]
+    assert {e["f"] for e in doc["events"]} <= (fs | hs)
+    assert len(doc["events"]) == 8
+
+
+def test_roundtrip_all_families_composed():
+    s = comb.schedule_to_json(_opts(ALL, fault_ops=18))
+    pkg = comb.schedule_from_json(s, db=comb._ScheduleDB(),
+                                  corrupt_paths=["/var/lib/db/wal"],
+                                  pace=False)
+    assert comb.schedule_to_json(pkg) == s
+    assert sorted(pkg.families) == sorted(ALL)
+    # the replayed generator emits exactly the recorded events
+    doc = json.loads(s)
+    test = {"nodes": doc["nodes"], "db": comb._ScheduleDB()}
+    replayed = []
+    while True:
+        o = gen_mod.op(pkg.generator, test, "nemesis")
+        if o is None:
+            break
+        replayed.append((o["f"], o.get("value")))
+    assert replayed == [(e["f"], e.get("value")) for e in doc["events"]]
+
+
+def test_roundtrip_via_file(tmp_path):
+    p = tmp_path / "sched.json"
+    s = comb.schedule_to_json(_opts(["partition", "packet"]))
+    p.write_text(s)
+    pkg = comb.load_schedule_file(str(p), pace=False)
+    assert comb.schedule_to_json(pkg) == s
+
+
+def test_from_json_requires_db_for_process_faults():
+    s = comb.schedule_to_json(_opts(["kill"]))
+    with pytest.raises(ValueError, match="db.Kill"):
+        comb.schedule_from_json(s)
+
+
+def test_from_json_rejects_bad_version():
+    with pytest.raises(ValueError, match="version"):
+        comb.schedule_from_json({"version": 2, "events": []})
+
+
+def test_from_json_retargets_corruption_paths():
+    # materialized without corrupt_paths: specs carry the null-path
+    # placeholder, which replay fills from the caller's real paths
+    opts = _opts(["corruption"])
+    del opts["corrupt_paths"]
+    s = comb.schedule_to_json(opts)
+    pkg = comb.schedule_from_json(s, corrupt_paths=["/real/path"],
+                                  pace=False)
+    test = {"nodes": json.loads(s)["nodes"]}
+    seen = []
+    while True:
+        o = gen_mod.op(pkg.generator, test, "nemesis")
+        if o is None:
+            break
+        seen.extend(spec["path"] for spec in o["value"])
+    assert seen and set(seen) == {"/real/path"}
+
+
+# ---------------------------------------------------------------------------
+# Determinism audit: schedule is a pure function of the seed
+
+@pytest.mark.parametrize("fam", ALL)
+def test_same_seed_byte_identical(fam):
+    a = comb.schedule_to_json(_opts([fam], seed=77))
+    b = comb.schedule_to_json(_opts([fam], seed=77))
+    assert a == b
+    assert a != comb.schedule_to_json(_opts([fam], seed=78))
+
+
+def test_composed_same_seed_byte_identical():
+    a = comb.schedule_to_json(_opts(ALL, seed=5, fault_ops=20))
+    b = comb.schedule_to_json(_opts(ALL, seed=5, fault_ops=20))
+    assert a == b
+
+
+def test_corruption_bytes_and_clock_offsets_are_seeded():
+    """The historically-unseeded draws: bitflip replacement bytes and
+    clock scramble offsets must ride in the schedule document (so the
+    nemeses apply them value-driven, not from their own rng)."""
+    doc = json.loads(comb.schedule_to_json(
+        _opts(["corruption", "clock"], seed=3, fault_ops=12)))
+    bitflips = [spec for e in doc["events"] if e["f"] == "corrupt-file"
+                for spec in e["value"] if spec["kind"] == "bitflip"]
+    for spec in bitflips:
+        assert "byte" in spec and 0 <= spec["byte"] <= 255
+    scrambles = [e for e in doc["events"] if e["f"] == "scramble-clock"]
+    assert scrambles
+    for e in scrambles:
+        assert isinstance(e["value"], dict) and e["value"], (
+            "scramble-clock must carry per-node offsets")
+
+
+def test_same_seed_across_processes():
+    """Byte-identity must hold across interpreter launches (no
+    PYTHONHASHSEED or id()-ordering dependence anywhere)."""
+    prog = ("import json; from jepsen_tpu.nemesis import combined as C; "
+            "print(C.schedule_to_json({'faults': list(C.FAULT_FAMILIES), "
+            "'seed': 123, 'fault_ops': 15, 'interval': 2.0, "
+            "'corrupt_paths': ['/w']}))")
+    outs = [subprocess.run([sys.executable, "-c", prog],
+                           capture_output=True, text=True, check=True,
+                           ).stdout
+            for _ in range(2)]
+    assert outs[0] == outs[1]
+    here = comb.schedule_to_json({"faults": ALL, "seed": 123,
+                                  "fault_ops": 15, "interval": 2.0,
+                                  "corrupt_paths": ["/w"]})
+    assert outs[0].strip() == here
+
+
+def test_clock_scrambler_honors_value_offsets():
+    """ClockScrambler applies a Mapping op.value verbatim (the replay
+    path) instead of drawing fresh offsets."""
+    from jepsen_tpu import nemesis as nem_root
+
+    applied = {}
+
+    def set_time(test, node, t):
+        applied[node] = t
+
+    sc = nem_root.ClockScrambler(dt=60.0, set_time_fn=set_time)
+    test = {"nodes": ["n1", "n2", "n3"]}
+    op = type("O", (), {})()
+    op.f = "scramble"
+    op.value = {"n1": 10.0, "n2": -4.5}
+    op.with_ = lambda **kw: {"applied": True, **kw}
+    sc.invoke(test, op)
+    assert set(applied) == {"n1", "n2"}, "n3 outside the map must keep time"
+
+
+def test_fuzz_doc_interop():
+    """fuzz.schedule.to_nemesis_doc emits the same document shape:
+    it loads, replays, and round-trips through combined."""
+    from jepsen_tpu.fuzz.schedule import (DEFAULT_SPEC, random_schedule,
+                                          to_nemesis_doc)
+
+    checked = 0
+    for seed in range(12):
+        sched = random_schedule(seed, DEFAULT_SPEC)
+        doc = to_nemesis_doc(sched, DEFAULT_SPEC, seed=seed)
+        if not doc["events"]:
+            continue
+        s = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        pkg = comb.schedule_from_json(s, db=comb._ScheduleDB(),
+                                      corrupt_paths=["/w"], pace=False)
+        assert comb.schedule_to_json(pkg) == s
+        checked += 1
+    assert checked

@@ -50,6 +50,12 @@ resumable-analysis parity) and exits 0 iff no engine contradicted any
 expected verdict, the cycle engines agreed throughout, and resumed
 analysis matched uninterrupted analysis.
 
+Fuzz-discovered anomalies replay too (the "fuzz" block): every trace
+committed to tests/fixtures/fuzz_anomalies.jsonl re-simulates from its
+(wseed, schedule) pair bit-identically on host and device, and its
+decoded history must reproduce the recorded anomaly classes through
+the standard cycle-checker path on both closure engines.
+
 Usage:  python tools/replay_parity.py  [--out PATH]
 """
 
@@ -570,6 +576,69 @@ def replay_resume() -> dict:
     return out
 
 
+def replay_fuzz() -> dict:
+    """Fuzz-corpus parity: every committed discovered-anomaly trace
+    (tests/fixtures/fuzz_anomalies.jsonl, a real fixed-seed fuzz run —
+    see generate_fuzz_corpus.py) re-simulates from its (wseed,
+    schedule) pair bit-identically on host and device, and its decoded
+    history replays through the STANDARD cycle checker path
+    (deps.extract + anomalies.classify) on both closure engines — the
+    verdicts must reproduce the anomaly classes the fuzzer recorded.
+    A fuzz finding that the real checker can't confirm is a scorer bug,
+    not a discovery."""
+    import numpy as np
+
+    from jepsen_tpu.fuzz import loop as fuzz_loop
+    from jepsen_tpu.fuzz import schedule as fuzz_sched
+    from jepsen_tpu.fuzz import score as fuzz_score
+    from jepsen_tpu.fuzz import sim as fuzz_sim
+
+    t0 = time.monotonic()
+    corpus_path = os.path.join(ROOT, "tests", "fixtures",
+                               "fuzz_anomalies.jsonl")
+    out: dict = {"corpus": os.path.relpath(corpus_path, ROOT),
+                 "engines": ["host", "tpu"], "cases": 0, "matched": 0,
+                 "sim_mismatches": 0, "mismatches": [], "failures": 0}
+    with open(corpus_path) as fh:
+        entries = [json.loads(ln) for ln in fh if ln.strip()]
+    for e in entries:
+        out["cases"] += 1
+        try:
+            spec = fuzz_loop.spec_from_doc(e["spec"])
+            sched = fuzz_sched.schedule_from_lists(e["schedule"], spec)
+            wseeds = np.array([e["wseed"]], dtype=np.int64)
+            scheds = sched[np.newaxis]
+            rh = fuzz_sim.simulate_batch(scheds, wseeds, spec,
+                                         engine="host")[0]
+            rd = fuzz_sim.simulate_batch(scheds, wseeds, spec,
+                                         engine="tpu")[0]
+            if any(not np.array_equal(np.asarray(rh[k]),
+                                      np.asarray(rd[k])) for k in rh):
+                out["sim_mismatches"] += 1
+                log(f"  fuzz: {e['id']} sim host/device divergence")
+                continue
+            verdicts = {
+                eng: sorted(fuzz_score.check_trace(
+                    rh, spec, engine=eng)["anomaly-types"])
+                for eng in ("host", "tpu")}
+            want = sorted(e["types"])
+            if all(v == want for v in verdicts.values()):
+                out["matched"] += 1
+            else:
+                out["mismatches"].append(
+                    {"case": e["id"], "recorded": want,
+                     "verdicts": verdicts})
+                log(f"  fuzz: {e['id']} verdict mismatch {verdicts} "
+                    f"(recorded {want})")
+        except Exception as exc:  # noqa: BLE001 — counted, not fatal
+            out["failures"] += 1
+            log(f"  fuzz: {e.get('id')} failed ({exc!r}); counted")
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = (not out["mismatches"] and not out["failures"]
+                 and out["sim_mismatches"] == 0 and out["cases"] > 0)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=os.path.join(ROOT, "PARITY.json"),
@@ -627,8 +696,13 @@ def main(argv=None) -> int:
     resume_out = replay_resume()
     log(f"  resume: {resume_out}")
 
+    log("replaying fuzz-discovered anomaly traces ...")
+    fuzz_out = replay_fuzz()
+    log(f"  fuzz: {fuzz_out}")
+
     ok = (all(not e.get("mismatches") for e in engines.values())
-          and cycle_out["ok"] and mesh_out["ok"] and resume_out["ok"])
+          and cycle_out["ok"] and mesh_out["ok"] and resume_out["ok"]
+          and fuzz_out["ok"])
     # supervision telemetry (per-engine failure kinds, demotions,
     # breaker trips) for any checks that routed through the supervisor
     # during the replay — zeros on a healthy run
@@ -647,6 +721,7 @@ def main(argv=None) -> int:
         "cycle": cycle_out,
         "mesh": mesh_out,
         "resume": resume_out,
+        "fuzz": fuzz_out,
         "supervision": supervision,
         "ok": ok,
     }
