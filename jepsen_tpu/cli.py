@@ -584,5 +584,61 @@ def fuzz_cmd() -> dict:
         "replay parity.")}
 
 
+def watch_cmd() -> dict:
+    """The `watch` subcommand: stream a history WAL or foreign trace
+    (Jepsen EDN, OTLP-ish span JSONL) through the online frontiers,
+    printing one JSON verdict line per window. Verdicts are
+    bit-identical to the batch checker on every checked prefix; with a
+    state dir they are crash-safe — a SIGKILL'd watch resumed over the
+    same stream re-emits nothing and misses nothing."""
+
+    def opt_spec(p):
+        p.add_argument(
+            "trace", metavar="PATH",
+            help="history WAL (history.wal.jsonl), Jepsen EDN history, "
+            "or span-log JSONL")
+        p.add_argument(
+            "--follow", action="store_true",
+            help="Tail the WAL for appended ops instead of reading it "
+            "once (native WALs only)")
+        p.add_argument(
+            "--window", type=int, default=256, metavar="N",
+            help="Ops per verdict window (the lag bound)")
+        p.add_argument(
+            "--workload", default="cycle", metavar="NAME",
+            help="Serve-registry workload that rehydrates + checks the "
+            "ops (cycle, register)")
+        p.add_argument(
+            "--state-dir", default=None, metavar="DIR",
+            help="Durable session state: the fsync'd verdict log and "
+            "the closure/per-key memo journal (enables SIGKILL-safe "
+            "resume)")
+        p.add_argument(
+            "--abort-on-invalid", action="store_true",
+            help="Stop consuming at the first definite falsification "
+            "(invalidity is monotone under extension)")
+        p.add_argument(
+            "--max-ops", type=int, default=None, metavar="N",
+            help="Stop after N ops (deterministic end for a tailed "
+            "stream)")
+        p.add_argument(
+            "--poll", type=float, default=0.05, metavar="SECONDS",
+            help="Tail poll interval")
+
+    def run(opts):
+        from .online.watch import run_watch
+
+        try:
+            return run_watch(opts)
+        except ValueError as e:
+            raise CliError(str(e)) from e
+
+    return {"watch": Subcommand(
+        run=run, opt_spec=opt_spec,
+        usage="Stream a WAL or foreign trace through the online "
+        "checker frontiers; one JSON verdict line per window, exit 1 "
+        "on a definite falsification.")}
+
+
 if __name__ == "__main__":  # the reference's jepsen.cli/-main (cli.clj:399-402)
-    main({**serve_cmd(), **doctor_cmd(), **fuzz_cmd()})
+    main({**serve_cmd(), **doctor_cmd(), **fuzz_cmd(), **watch_cmd()})

@@ -574,6 +574,18 @@ def run_case(test) -> list:
         except Exception:  # noqa: BLE001 — checkpointing is best-effort
             log.warning("couldn't open run checkpoint", exc_info=True)
             ckpt_stop = None
+    monitor = None
+    if test.get("online"):
+        # streaming verdicts with bounded lag during the run; on a
+        # definite falsification the monitor sets test["_drain"] (the
+        # SIGTERM drain gate) so the doomed run winds down early
+        try:
+            from .online.monitor import RunMonitor
+
+            monitor = RunMonitor(test).start()
+        except Exception:  # noqa: BLE001 — monitoring is advisory
+            log.warning("couldn't start online monitor", exc_info=True)
+            monitor = None
     try:
         nodes = test["nodes"] or [None]
         client_nodes = [
@@ -592,6 +604,8 @@ def run_case(test) -> list:
         workers = [NemesisWorker(test)] + client_workers
         run_workers(test, workers)
     finally:
+        if monitor is not None:
+            monitor.stop()
         if ckpt_stop is not None:
             ckpt_stop.set()
             ticker.join(timeout=2.0)
@@ -790,6 +804,10 @@ def analyze(test) -> dict:
         if journal is not None:
             test.pop("_analysis_journal", None)
             journal.close()
+    if test.get("_online_abort") and isinstance(test["results"], dict):
+        # early abort changed when the run stopped, not what the batch
+        # analysis concluded; surface both
+        test["results"]["online-abort"] = test["_online_abort"]
     log.info("Analysis complete")
     if test.get("name") and test.get("start_time"):
         try:

@@ -1,0 +1,37 @@
+"""Online streaming checker: verdicts during the run, on traces from
+anywhere.
+
+Every other checker in this repo is batch-only — verdicts arrive after
+the run ends, even though the WAL streams every op durably as it lands
+and the analysis journal already memoizes per-key/per-component
+results. This package closes that loop (ROADMAP item 3):
+
+frontier.py  incremental transactional cycle checking: per-key edge
+             maintenance under appended ops, with only dirty
+             weakly-connected components re-squared (classify's
+             content-hash closure memo); verdicts bit-identical to
+             CycleChecker.check on every prefix.
+wgl.py       windowed per-key streaming advance of the independent
+             linearizable (WGL) checker: dirty keys re-check in one
+             packed check_batch window, verdicts recombine through
+             independent.combine_results.
+ingest.py    foreign trace adapters — Jepsen EDN histories and
+             OTLP-ish span-log JSONL — mapped onto the WAL op schema.
+stream.py    the StreamSession: deterministic window boundaries, a
+             crash-safe fsync'd verdict log (SIGKILL/resume emits each
+             verdict exactly once), bounded lag, early abort.
+monitor.py   in-run monitoring: core.run_case streams the live
+             history and drains doomed runs via the test["_drain"]
+             gate the SIGTERM path already honors.
+client.py    a WAL stream as a serve-queue client: window snapshots
+             submitted to the resident daemon, packed across
+             concurrent streams by independent.pack_check.
+watch.py     the `jepsen-tpu watch <wal-or-trace> [--follow]` CLI.
+"""
+
+from .client import QueueStreamClient  # noqa: F401
+from .frontier import ClosureMemo, CycleFrontier  # noqa: F401
+from .ingest import edn_ops, iter_trace, read_edn, span_ops  # noqa: F401
+from .stream import (StreamSession, VerdictLog,  # noqa: F401
+                     frontier_for)
+from .wgl import WGLFrontier  # noqa: F401

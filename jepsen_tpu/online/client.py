@@ -1,0 +1,82 @@
+"""A WAL stream as a serve-queue client.
+
+The resident daemon's queue (serve/queue.py) doesn't care where a
+history came from — so a live WAL (or a foreign trace) can act as just
+another client: ``QueueStreamClient`` follows a stream and submits a
+prefix snapshot every ``window`` ops. Each submission is a complete,
+independently-checkable history (the daemon is stateless per job), and
+because the daemon packs every batch through
+``independent.pack_check``, window lanes from MANY concurrent streams
+ride the same device launches — cross-stream packing for free, with
+each stream's verdicts still bit-identical to one-shot checks
+(P-compositionality).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..history import Op
+
+log = logging.getLogger("jepsen_tpu.online.client")
+
+__all__ = ["QueueStreamClient"]
+
+
+class QueueStreamClient:
+    """Submit prefix snapshots of an op stream to a DurableQueue.
+
+    queue     a serve.DurableQueue (or anything with its submit())
+    client    the client id submissions are attributed (and weighted)
+              under
+    workload  the daemon workload name that rehydrates + checks the
+              ops ("register", "cycle", ...)
+    window    ops per submission boundary
+    weight    the client's weighted-round-robin share
+    """
+
+    def __init__(self, queue, client: str, workload: str = "register", *,
+                 window: int = 256, weight: int = 1):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.queue = queue
+        self.client = str(client)
+        self.workload = workload
+        self.window = window
+        self.weight = weight
+        self.job_ids: list = []
+        self.consumed = 0
+
+    def submit_prefix(self, ops) -> str:
+        """Submit one snapshot; returns its durable job id."""
+        history = [o.to_dict() if isinstance(o, Op) else dict(o)
+                   for o in ops]
+        job_id = self.queue.submit(self.client, self.workload, history,
+                                   weight=self.weight)
+        self.job_ids.append(job_id)
+        return job_id
+
+    def stream(self, source, *, max_ops=None) -> list:
+        """Consume a stream, submitting at every window boundary and
+        once at stream end; returns the submitted job ids in order.
+        The LAST id's verdict is the stream's final verdict."""
+        buf: list = []
+        n = 0
+        for op in source:
+            buf.append(op)
+            n += 1
+            if n % self.window == 0:
+                self.submit_prefix(buf)
+            if max_ops is not None and n >= max_ops:
+                break
+        if n % self.window:
+            self.submit_prefix(buf)
+        self.consumed = n
+        return self.job_ids
+
+    def final_verdict(self, timeout: float | None = None):
+        """Block for the last submission's verdict."""
+        if not self.job_ids:
+            return None
+        return self.queue.wait_for_verdict(self.job_ids[-1],
+                                           timeout=timeout)

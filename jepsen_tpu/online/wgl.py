@@ -1,0 +1,131 @@
+"""Windowed WGL frontier: per-independent-key streaming advance of the
+linearizable checker.
+
+The frontier ingests a keyed (KVTuple-valued) history op by op and, on
+each ``advance``, re-checks ONLY the keys whose subhistory actually
+changed since their last verdict — every dirty key's subhistory goes
+through the wrapped sub-checker in one ``check_batch`` call (the same
+cross-key window packing ``independent.IndependentChecker`` and the
+serve daemon's ``pack_check`` use), and the per-key verdicts recombine
+through ``independent.combine_results``, THE recombination. Unchanged
+keys keep their memoized verdicts, identified by
+``independent._journal_key`` — the exact per-key content identity the
+``store.AnalysisJournal`` "independent-key" kind journals — so a
+frontier backed by a journal resumes across process kills.
+
+Bit-identity contract: ``advance()`` returns what
+``IndependentChecker.check(test, history[:n], {})`` returns for the
+same prefix, minus "supervision" telemetry (whose shape legitimately
+differs — the streaming path ran fewer, smaller launches) and store
+artifacts. P-compositionality licenses the reuse: a key's verdict
+depends only on its own subhistory, never on which batch its lane
+rode in.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .. import independent as indep
+from ..checker import check_safe
+from ..history import ops as _ops
+
+log = logging.getLogger("jepsen_tpu.online.wgl")
+
+__all__ = ["WGLFrontier"]
+
+
+class WGLFrontier:
+    """Streaming frontier over one keyed history.
+
+    checker  an ``independent.IndependentChecker`` (e.g. the serve
+             registry's register workload: independent over the WGL
+             linearizable search); its wrapped sub-checker does the
+             per-key work, batched through ``check_batch`` when it has
+             one
+    test     the test map handed to the sub-checker (model, name, ...)
+    journal  optional store.AnalysisJournal to write per-key verdicts
+             through to ("independent-key" kind, resume support)
+    """
+
+    def __init__(self, checker: indep.IndependentChecker, *, test=None,
+                 journal=None):
+        if not isinstance(checker, indep.IndependentChecker):
+            raise TypeError(
+                f"WGLFrontier wants an IndependentChecker, got "
+                f"{type(checker).__name__}")
+        self.checker = checker
+        self.test = test or {}
+        self.journal = journal
+        self.ops: list = []
+        self._keys: set = set()
+        self._dirty: set = set()
+        self._global_dirty = False  # a non-tuple op joins EVERY subhistory
+        self._verdicts: dict = {}   # key -> verdict for its current sub
+        self._jkeys: dict = {}      # key -> _journal_key of that verdict
+        self.checked = 0
+        self.verdict: dict | None = None
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    @property
+    def pending(self) -> int:
+        return len(self.ops) - self.checked
+
+    def append(self, op) -> None:
+        (o,) = _ops([op])
+        self.ops.append(o)
+        if indep.is_tuple(o.value):
+            self._keys.add(o.value.key)
+            self._dirty.add(o.value.key)
+        else:
+            self._global_dirty = True
+
+    def extend(self, ops) -> None:
+        for op in ops:
+            self.append(op)
+
+    def advance(self) -> dict:
+        """Re-check dirty keys, recombine everything, return (and
+        store in ``.verdict``) the batch-identical result dict."""
+        self.checked = len(self.ops)
+        dirty = set(self._keys) if self._global_dirty else set(self._dirty)
+        self._dirty.clear()
+        self._global_dirty = False
+
+        todo = []  # (key, subhistory, journal key, per-item opts)
+        for k in sorted(dirty, key=str):
+            sub = indep.subhistory(k, self.ops)
+            jk = indep._journal_key(k, sub)
+            if self._jkeys.get(k) == jk:
+                continue  # marked dirty, but content-identical
+            if self.journal is not None:
+                r = self.journal.get("independent-key", jk)
+                if r is not None:
+                    self._verdicts[k], self._jkeys[k] = r, jk
+                    continue
+            todo.append((k, sub, jk,
+                         {"subdirectory": [indep.DIR, str(k)],
+                          "history_key": k}))
+        if todo:
+            for (k, _sub, jk, _o), r in zip(todo, self._check(todo)):
+                self._verdicts[k], self._jkeys[k] = r, jk
+                if self.journal is not None:
+                    self.journal.record("independent-key", jk, r)
+        self.verdict = indep.combine_results(dict(self._verdicts))
+        return self.verdict
+
+    def _check(self, todo) -> list:
+        """One batched pass over the dirty keys' window — the same
+        batch-else-per-key structure IndependentChecker.check runs."""
+        sub_checker = self.checker.checker
+        if len(todo) > 1 and hasattr(sub_checker, "check_batch"):
+            try:
+                return sub_checker.check_batch(
+                    self.test, [(sub, o) for _, sub, _, o in todo])
+            except Exception:  # noqa: BLE001 — degrade to per-key path
+                log.warning("batched window check failed; falling back "
+                            "to per-key", exc_info=True)
+        return [check_safe(sub_checker, self.test, sub, o)
+                for _, sub, _, o in todo]

@@ -32,3 +32,13 @@ daemon.py    the HTTP front end (`jepsen-tpu serve --daemon`):
 from .bundle import EngineBundle  # noqa: F401
 from .queue import DurableQueue, QueueFull  # noqa: F401
 from .registry import EngineRegistry  # noqa: F401
+
+
+def __getattr__(name):
+    # a live WAL is just another queue client (online/client.py); the
+    # import stays lazy so serve/ itself remains checker-import-free
+    if name == "QueueStreamClient":
+        from ..online.client import QueueStreamClient
+
+        return QueueStreamClient
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -277,6 +277,15 @@ class HistoryWAL:
         except (TypeError, ValueError):
             return 1
 
+    @staticmethod
+    def follow(p: str, *, poll_s: float = 0.05, stop=None):
+        """Tail-follow reader over a (possibly live) WAL file: yields
+        reindexed Ops as lines land, holding a torn tail back until a
+        resumed writer terminates it. Delegates to ``follow_wal`` —
+        the same parse/stitch logic ``load_wal_history`` batch-reads
+        with."""
+        return follow_wal(p, follow=True, poll_s=poll_s, stop=stop)
+
     def append(self, op: Op) -> None:
         with self._lock:
             if self._f is None:
@@ -379,8 +388,15 @@ class AnalysisJournal:
     round-trip through JSON — Ops inside come back as plain dicts — so
     consumers treat them as opaque verdicts, not live objects."""
 
-    def __init__(self, test):
-        self._path = path_(test, ANALYSIS_CKPT_FILE)
+    def __init__(self, test, path: str | None = None):
+        """Open a test's journal, or — with an explicit ``path`` — a
+        free-standing one (the online watch sessions keep theirs in a
+        state dir with no test map at all)."""
+        if path is None:
+            self._path = path_(test, ANALYSIS_CKPT_FILE)
+        else:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._path = path
         self._lock = threading.Lock()
         self._done: dict = {}
         try:
@@ -586,41 +602,115 @@ def load_history(test) -> list[Op]:
     raise FileNotFoundError(f"no stored history under {path(test)}")
 
 
+def _parse_wal_line(line: str) -> tuple[int, Op] | None:
+    """One WAL line as an (epoch, op) pair, or None for a torn/blank
+    line. Strips the "_"-prefixed engine stamps before the op is
+    rebuilt (Op.from_dict would otherwise shelve them under .extra)."""
+    if not line.strip():
+        return None
+    try:
+        rec = json.loads(line)
+        epoch = int(rec.pop("_epoch", 0))
+        for k in [k for k in rec
+                  if isinstance(k, str) and k.startswith("_")]:
+            del rec[k]
+        return (epoch, Op.from_dict(rec))
+    except (ValueError, KeyError, TypeError, AttributeError):
+        # torn tail from a mid-write kill: salvage the prefix
+        log.warning("WAL: dropping unparseable line %r", line[:80])
+        return None
+
+
 def _parse_wal(p: str) -> list[tuple[int, Op]]:
-    """(epoch, op) pairs from a WAL file, tolerating a torn tail and
-    stripping the "_"-prefixed engine stamps before ops are rebuilt
-    (Op.from_dict would otherwise shelve them under .extra)."""
+    """(epoch, op) pairs from a WAL file, tolerating a torn tail."""
     out = []
     with open(p) as f:
         for line in f:
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-                epoch = int(rec.pop("_epoch", 0))
-                for k in [k for k in rec
-                          if isinstance(k, str) and k.startswith("_")]:
-                    del rec[k]
-                out.append((epoch, Op.from_dict(rec)))
-            except (ValueError, KeyError, TypeError, AttributeError):
-                # torn tail from a mid-write kill: salvage the prefix
-                log.warning("WAL: dropping unparseable line %r", line[:80])
+            pair = _parse_wal_line(line)
+            if pair is not None:
+                out.append(pair)
     return out
 
 
+def _stitch_wal(pairs: list[tuple[int, Op]]) -> list[Op]:
+    """Stitch (epoch, op) pairs into one history, reindexed 0..n-1.
+    Stable sort by session epoch first (arrival order preserved within
+    an epoch), so a run appended across resume sessions gets monotonic,
+    collision-free indices — WAL lines land BEFORE history finalization
+    assigns indices (index=-1), and pairs/checkers require monotonic
+    ones."""
+    pairs = sorted(pairs, key=lambda pair: pair[0])
+    return [o.with_(index=i) for i, (_, o) in enumerate(pairs)]
+
+
 def load_wal_history(test) -> list[Op]:
-    """The salvageable ops of a run's WAL, reindexed 0..n-1. Lines are
-    stable-sorted by session epoch first (arrival order preserved
-    within an epoch), so a run appended across resume sessions gets
-    monotonic, collision-free indices — WAL lines land BEFORE history
-    finalization assigns indices (index=-1), and pairs/checkers require
-    monotonic ones. Returns [] when no WAL exists."""
+    """The salvageable ops of a run's WAL, stitched and reindexed.
+    Returns [] when no WAL exists."""
     p = path(test, WAL_FILE)
     if not os.path.exists(p):
         return []
-    pairs = _parse_wal(p)
-    pairs.sort(key=lambda pair: pair[0])
-    return [o.with_(index=i) for i, (_, o) in enumerate(pairs)]
+    return _stitch_wal(_parse_wal(p))
+
+
+def follow_wal(p: str, *, follow: bool = False, poll_s: float = 0.05,
+               stop=None):
+    """Iterate a WAL file's salvageable ops, reindexed exactly as
+    ``load_wal_history`` stitches them (same per-line salvage, same
+    epoch-stable order — a WAL only ever appends, and every session's
+    epoch exceeds its predecessors', so file order IS stitch order).
+
+    With ``follow=False`` this is the one-shot batch read. With
+    ``follow=True`` the iterator tails the file: it keeps polling for
+    appended lines (surviving the file not existing yet) until ``stop``
+    (a threading.Event) is set. Only newline-terminated records are
+    yielded while tailing — a torn tail from a mid-write kill is held
+    back, and becomes visible the moment a resumed session's
+    ``HistoryWAL`` terminates it (or is dropped by its parse failure),
+    matching the batch reader's salvage behavior."""
+    if not follow:
+        if os.path.exists(p):
+            yield from _stitch_wal(_parse_wal(p))
+        return
+    import time as _time
+
+    f = None
+    buf = b""
+    idx = 0
+    try:
+        while True:
+            if f is None:
+                try:
+                    f = open(p, "rb")
+                except OSError:
+                    f = None
+            progressed = False
+            if f is not None:
+                chunk = f.read()
+                if chunk:
+                    progressed = True
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        pair = _parse_wal_line(
+                            line.decode("utf-8", "replace"))
+                        if pair is None:
+                            continue
+                        yield pair[1].with_(index=idx)
+                        idx += 1
+            if stop is not None and stop.is_set():
+                return
+            if not progressed:
+                _time.sleep(poll_s)
+    finally:
+        if f is not None:
+            f.close()
+
+
+def follow_wal_history(test, *, follow: bool = False, poll_s: float = 0.05,
+                       stop=None):
+    """``follow_wal`` over a test's own WAL path."""
+    return follow_wal(path(test, WAL_FILE), follow=follow, poll_s=poll_s,
+                      stop=stop)
 
 
 def load(name, time_s, store_dir=None) -> dict:

@@ -1,0 +1,531 @@
+"""Online streaming checker tests: WAL tail-follow, trace ingest,
+streaming≡batch verdict parity for both frontiers, window memoization,
+crash-safe emission dedup, early abort, the watch CLI, and the
+serve-queue stream client."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from helpers import random_register_history
+from jepsen_tpu import independent as indep
+from jepsen_tpu import store
+from jepsen_tpu.checker import cycle
+from jepsen_tpu.history import index
+from jepsen_tpu.online import (CycleFrontier, StreamSession, VerdictLog,
+                               WGLFrontier, ingest)
+from jepsen_tpu.online.stream import frontier_for
+from jepsen_tpu.serve.registry import WORKLOAD_FACTORIES
+from jepsen_tpu.workloads import list_append
+
+pytestmark = pytest.mark.online
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "edn")
+
+
+def strip_supervision(v):
+    """Verdict comparison ignores supervision telemetry — it reflects
+    HOW MANY launches ran (streaming runs fewer, smaller ones), not
+    what they concluded."""
+    if isinstance(v, dict):
+        return {k: strip_supervision(x) for k, x in v.items()
+                if k != "supervision"}
+    if isinstance(v, list):
+        return [strip_supervision(x) for x in v]
+    return v
+
+
+def keyed_register_history(keys=4, n_ops=10, corrupt_key=None, seed0=11):
+    hist = []
+    for k in range(keys):
+        sub = random_register_history(
+            n_process=3, n_ops=n_ops, n_values=3, cas=True,
+            corrupt=(k == corrupt_key), seed=seed0 + k)
+        for o in sub:
+            hist.append(o.with_(value=indep.tuple_(k, o.value)))
+    return index(hist)
+
+
+# ---------------------------------------------------------------------------
+# store.follow_wal (satellite: tail-follow reader)
+
+def _wal_line(rec):
+    return json.dumps(rec) + "\n"
+
+
+def test_follow_wal_batch_matches_load_wal_history(tmp_path):
+    d = tmp_path / "t" / "20240101T000000.000"
+    d.mkdir(parents=True)
+    p = str(d / store.WAL_FILE)
+    with open(p, "w") as f:
+        for i in range(4):
+            f.write(_wal_line({"process": 0, "type": "ok", "f": "txn",
+                               "value": [["append", 1, i]], "_epoch": 0}))
+        f.write('{"torn')  # mid-write kill
+    test = {"name": "t", "start_time": "20240101T000000.000",
+            "store_dir": str(tmp_path)}
+    batch = store.load_wal_history(test)
+    followed = list(store.follow_wal(p))
+    assert [o.to_dict() for o in followed] == [o.to_dict() for o in batch]
+    assert [o.index for o in followed] == list(range(4))
+
+
+def test_follow_wal_tails_across_epoch_rollover(tmp_path):
+    p = str(tmp_path / store.WAL_FILE)
+    got = []
+    stop = threading.Event()
+
+    def tail():
+        for o in store.follow_wal(p, follow=True, poll_s=0.005, stop=stop):
+            got.append(o)
+
+    t = threading.Thread(target=tail)
+    t.start()  # starts before the file even exists
+    try:
+        with open(p, "a") as f:
+            for i in range(3):
+                f.write(_wal_line({"process": 0, "type": "ok", "f": "txn",
+                                   "value": [["append", 1, i]],
+                                   "_epoch": 0}))
+            f.write('{"process": 0, "type"')  # torn tail, no newline
+            f.flush()
+        deadline = time.time() + 5
+        while len(got) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 3  # torn line held back, prefix salvaged
+        # a resumed session terminates the torn tail and appends epoch 1
+        with open(p, "a") as f:
+            f.write("\n")
+            f.write(_wal_line({"process": 1, "type": "ok", "f": "txn",
+                               "value": [["r", 1, [0, 1, 2]]],
+                               "_epoch": 1}))
+        deadline = time.time() + 5
+        while len(got) < 4 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert [o.index for o in got] == [0, 1, 2, 3]
+    # identical to the batch stitch/reindex over the final file
+    batch = store.follow_wal(p)
+    assert [o.to_dict() for o in got] == [o.to_dict() for o in batch]
+
+
+# ---------------------------------------------------------------------------
+# ingest (satellite: EDN fixture corpus round trip)
+
+def test_edn_reader_primitives():
+    assert ingest.read_edn("nil") is None
+    assert ingest.read_edn("true") is True
+    assert ingest.read_edn("-42") == -42
+    assert ingest.read_edn("1.5") == 1.5
+    assert ingest.read_edn('"a\\"b"') == 'a"b'
+    assert ingest.read_edn(":invoke") == "invoke"
+    assert ingest.read_edn("[1 2, 3]") == [1, 2, 3]
+    assert ingest.read_edn("{:f :txn :value [[:r 1 nil]]}") == \
+        {"f": "txn", "value": [["r", 1, None]]}
+    assert ingest.read_edn("#{1 2}") == [1, 2]
+    assert ingest.read_edn('#inst "2024-01-01"') == "2024-01-01"
+    assert ingest.read_edn("#jepsen.history.Op{:index 0}") == {"index": 0}
+    assert ingest.read_edn("; comment\n7") == 7
+    assert ingest.read_edn_all("1 2 3") == [1, 2, 3]
+    with pytest.raises(ingest.EDNError):
+        ingest.read_edn("[1 2")
+
+
+def test_edn_fixture_roundtrip_matches_expected():
+    """EDN → WAL schema → batch verdict matches the pre-computed
+    expectation for every fixture in the corpus."""
+    with open(os.path.join(FIXTURES, "expected.json")) as f:
+        expected = json.load(f)
+    assert expected  # corpus present
+    for name, exp in sorted(expected.items()):
+        p = os.path.join(FIXTURES, name)
+        assert ingest.detect_format(p) == "edn"
+        ops = list(ingest.iter_trace(p))
+        assert ops and all(o.index == i for i, o in enumerate(ops))
+        spec = WORKLOAD_FACTORIES[exp["workload"]]()
+        if spec.get("rehydrate"):
+            ops = [spec["rehydrate"](o) for o in ops]
+        r = spec["checker"].check({"name": "fixture"}, ops, {})
+        assert r["valid"] == exp["valid"], name
+        assert (r.get("anomaly-types") or []) == exp["anomaly-types"], name
+
+
+def test_span_log_ingest():
+    spans = [
+        {"name": "write", "startTimeUnixNano": 100, "endTimeUnixNano": 200,
+         "status": {"code": "STATUS_CODE_OK"},
+         "attributes": [
+             {"key": "jepsen.process", "value": {"intValue": "0"}},
+             {"key": "jepsen.value", "value": {"intValue": "3"}}]},
+        {"name": "read", "startTimeUnixNano": 300, "endTimeUnixNano": 400,
+         "status": {"code": "STATUS_CODE_OK"},
+         "attributes": {"jepsen.process": 1, "jepsen.value": None,
+                        "jepsen.value.ok": 3}},
+        {"name": "read", "startTimeUnixNano": 150, "endTimeUnixNano": 500,
+         "status": {"code": "STATUS_CODE_ERROR"},
+         "attributes": {"jepsen.process": 2, "jepsen.error": "timeout"}},
+    ]
+    ops = ingest.span_ops(json.dumps(s) for s in spans)
+    assert [(o["type"], o["f"]) for o in ops] == [
+        ("invoke", "write"), ("invoke", "read"), ("ok", "write"),
+        ("invoke", "read"), ("ok", "read"), ("fail", "read")]
+    assert ops[4]["value"] == 3  # jepsen.value.ok on the completion
+    assert ops[5]["error"] == "timeout"
+
+
+def test_detect_format_wal_vs_spans(tmp_path):
+    wal = tmp_path / "history.wal.jsonl"
+    wal.write_text(_wal_line({"process": 0, "type": "invoke", "f": "read",
+                              "value": None, "_epoch": 0}))
+    assert ingest.detect_format(str(wal)) == "wal"
+    sp = tmp_path / "trace.jsonl"
+    sp.write_text(json.dumps({"startTimeUnixNano": 1, "name": "x"}) + "\n")
+    assert ingest.detect_format(str(sp)) == "spans"
+
+
+# ---------------------------------------------------------------------------
+# CycleFrontier: streaming ≡ batch on every prefix (acceptance property)
+
+@pytest.mark.parametrize("seed,inject", [
+    (3, ()), (5, ("G1c",)), (9, ("G1c", "G-single")),
+])
+def test_cycle_frontier_matches_batch_on_every_prefix(seed, inject):
+    h = list_append.simulate(120, seed=seed, inject=inject)
+    chk = cycle.checker(engine="host")
+    f = CycleFrontier(chk)
+    for cut in (1, 7, 30, 64, 65, 100, 120):
+        f.extend(h[len(f.ops):cut])
+        assert strip_supervision(f.advance()) == \
+            strip_supervision(chk.check({}, h[:cut], {})), f"prefix {cut}"
+
+
+def test_cycle_frontier_unknown_prefix_matches_batch():
+    """A prefix that cuts a txn mid-flight (read observed, append not
+    yet landed) is uncheckable — and the streaming verdict must say so
+    exactly as the batch checker does."""
+    from jepsen_tpu.history import ok_op
+
+    h = index([
+        ok_op(0, "txn", [["append", 1, 10]]),
+        ok_op(1, "txn", [["r", 1, [10, 11]]]),   # observes 11 early
+        ok_op(2, "txn", [["append", 1, 11]]),
+    ])
+    chk = cycle.checker(engine="host")
+    f = CycleFrontier(chk)
+    for cut in (1, 2, 3):
+        f.extend(h[len(f.ops):cut])
+        assert strip_supervision(f.advance()) == \
+            strip_supervision(chk.check({}, h[:cut], {})), f"prefix {cut}"
+    assert f.verdict["valid"] is True  # writer landed: checkable again
+
+
+def test_cycle_frontier_reuses_clean_component_closures(monkeypatch):
+    """Only dirty weakly-connected components re-square: appending ops
+    that touch a fresh key must not resubmit the untouched components'
+    closure jobs."""
+    from jepsen_tpu.checker.cycle import anomalies as anomalies_mod
+
+    def shift_keys(h, off):
+        return [o.with_(value=[[m[0], m[1] + off, m[2]] for m in o.value])
+                for o in h]
+
+    h1 = list_append.simulate(60, seed=4, inject=())
+    h2 = shift_keys(list_append.simulate(60, seed=5, inject=()), 1000)
+    h = index(list(h1) + list(h2))
+    sizes = []
+    real = anomalies_mod._closures
+
+    def counting(mats, engine=None):
+        sizes.append(len(mats))
+        return real(mats, engine=engine)
+
+    monkeypatch.setattr(anomalies_mod, "_closures", counting)
+    f = CycleFrontier(cycle.checker(engine="host"))
+    f.extend(h[:len(h1)])
+    f.advance()
+    first = sum(sizes)
+    del sizes[:]
+    # the tail touches only fresh keys: h1's components stay clean
+    f.extend(h[len(h1):])
+    f.advance()
+    second = sum(sizes)
+    del sizes[:]
+    cold = CycleFrontier(cycle.checker(engine="host"))
+    cold.extend(h)
+    cold.advance()
+    full = sum(sizes)
+    assert first > 0 and full > 0
+    # the warm advance re-squared only the new components
+    assert second < full
+    assert len(f.memo) > 0
+
+
+def test_cycle_frontier_memo_survives_via_journal(tmp_path):
+    """A journal-backed frontier reloads closure memo entries across
+    process lifetimes (simulated by a fresh frontier over the same
+    journal path)."""
+    h = list_append.simulate(80, seed=6, inject=("G1c",))
+    jp = str(tmp_path / "analysis.ckpt.jsonl")
+    j1 = store.AnalysisJournal(None, path=jp)
+    f1 = CycleFrontier(cycle.checker(engine="host"), journal=j1)
+    f1.extend(h)
+    v1 = f1.advance()
+    j1.close()
+    j2 = store.AnalysisJournal(None, path=jp)
+    assert len(j2) > 0
+    f2 = CycleFrontier(cycle.checker(engine="host"), journal=j2)
+    f2.extend(h)
+    v2 = f2.advance()
+    j2.close()
+    assert strip_supervision(v1) == strip_supervision(v2)
+
+
+# ---------------------------------------------------------------------------
+# WGLFrontier: streaming ≡ batch on every prefix
+
+def test_wgl_frontier_matches_batch_on_every_prefix():
+    hist = keyed_register_history(keys=4, corrupt_key=2)
+    chk = WORKLOAD_FACTORIES["register"]()["checker"]
+    test = {"name": "stream-parity"}
+    f = WGLFrontier(chk, test=test)
+    for cut in (9, 25, 48, len(hist)):
+        f.extend(hist[len(f.ops):cut])
+        assert strip_supervision(f.advance()) == \
+            strip_supervision(chk.check(test, hist[:cut], {})), \
+            f"prefix {cut}"
+    assert f.verdict["valid"] is False
+    assert f.verdict["failures"] == [2]
+
+
+def test_wgl_frontier_rechecks_only_dirty_keys():
+    hist = keyed_register_history(keys=3, corrupt_key=None)
+    sub0 = [o for o in hist
+            if indep.is_tuple(o.value) and o.value.key == 0]
+    held_back = sub0[-4:]
+    first = [o for o in hist if o not in held_back]
+    chk = WORKLOAD_FACTORIES["register"]()["checker"]
+    f = WGLFrontier(chk, test={"name": "dirty"})
+    f.extend(first)  # every key seen; key 0 still missing its tail
+    f.advance()
+    checked = []
+    orig = f._check
+
+    def spy(todo):
+        checked.extend(k for k, *_ in todo)
+        return orig(todo)
+
+    f._check = spy
+    f.extend(held_back)
+    f.advance()
+    assert checked == [0]  # keys 1, 2 kept their memoized verdicts
+
+
+def test_frontier_for_dispatch():
+    assert isinstance(frontier_for(cycle.checker()), CycleFrontier)
+    chk = WORKLOAD_FACTORIES["register"]()["checker"]
+    assert isinstance(frontier_for(chk), WGLFrontier)
+    assert frontier_for(object()) is None
+
+
+# ---------------------------------------------------------------------------
+# StreamSession: deterministic windows, crash-safe dedup, early abort
+
+def test_stream_session_windows_and_final_partial(tmp_path):
+    h = list_append.simulate(100, seed=3, inject=())
+    log_path = str(tmp_path / "verdicts.jsonl")
+    vlog = VerdictLog(log_path)
+    emitted = []
+    s = StreamSession(iter(h), CycleFrontier(cycle.checker(engine="host")),
+                      window=32, verdict_log=vlog, emit=emitted.append)
+    final = s.run()
+    assert [r["prefix"] for r in emitted] == [32, 64, 96, 100]
+    assert final["valid"] is True
+    # resume over the same stream: every boundary replays, none re-emit
+    vlog2 = VerdictLog(log_path)
+    emitted2 = []
+    s2 = StreamSession(iter(h),
+                       CycleFrontier(cycle.checker(engine="host")),
+                       window=32, verdict_log=vlog2, emit=emitted2.append)
+    final2 = s2.run()
+    assert emitted2 == []
+    assert strip_supervision(final2) == strip_supervision(final)
+    assert len(vlog2.entries()) == 4
+
+
+def test_stream_session_resume_after_partial_run(tmp_path):
+    """Kill-and-resume semantics without the subprocess: a session
+    that stops mid-stream leaves a verdict log the resumed session
+    extends — union of emissions == uninterrupted run's, no dups."""
+    h = list_append.simulate(120, seed=8, inject=())
+    log_path = str(tmp_path / "verdicts.jsonl")
+    vlog = VerdictLog(log_path)
+    s1 = StreamSession(iter(h), CycleFrontier(cycle.checker(engine="host")),
+                       window=24, verdict_log=vlog, max_ops=60)
+    s1.run()
+    vlog.close()
+    assert [p for p, _, _ in VerdictLog(log_path).entries()] == [24, 48, 60]
+    vlog2 = VerdictLog(log_path)
+    emitted = []
+    s2 = StreamSession(iter(h),
+                       CycleFrontier(cycle.checker(engine="host")),
+                       window=24, verdict_log=vlog2, emit=emitted.append)
+    s2.run()
+    # 60 was a max_ops artifact of the killed session, not a window
+    # boundary of the full stream; the resumed run emits the real ones
+    assert [r["prefix"] for r in emitted] == [72, 96, 120]
+    prefixes = [p for p, _, _ in vlog2.entries()]
+    assert prefixes == [24, 48, 60, 72, 96, 120]
+    assert len(prefixes) == len(set(prefixes))
+
+
+def test_stream_session_aborts_on_midstream_g1c():
+    """Acceptance: an injected mid-stream G1c aborts before history
+    end with the anomaly reported."""
+    base = list_append.simulate(200, seed=12, inject=())
+    h = list(base[:100])
+    list_append.inject_g1c(h, proc=7, key_a=101, key_b=102)
+    h += base[100:]
+    h = index(h)
+    f = CycleFrontier(cycle.checker(engine="host"))
+    s = StreamSession(iter(h), f, window=16, abort_on_invalid=True)
+    final = s.run()
+    assert s.aborted
+    assert s.consumed < len(h)
+    assert s.abort_info["prefix"] < len(h)
+    assert "G1c" in s.abort_info["anomaly-types"]
+    assert final["valid"] is False
+    # the early verdict agrees with the batch verdict on that prefix
+    batch = cycle.checker(engine="host").check(
+        {}, h[:s.abort_info["prefix"]], {})
+    assert strip_supervision(final) == strip_supervision(batch)
+
+
+# ---------------------------------------------------------------------------
+# In-run monitor: the early-abort signal the core loop honors
+
+def test_run_monitor_drains_doomed_run():
+    from jepsen_tpu.online.monitor import RunMonitor
+
+    base = list_append.simulate(120, seed=12, inject=())
+    h = list(base[:60])
+    list_append.inject_g1c(h, proc=7, key_a=101, key_b=102)
+    h += base[60:]
+    h = index(h)
+    test = {
+        "checker": cycle.checker(engine="host"),
+        "online": {"window": 16, "poll_s": 0.005},
+        "_history": [], "_history_lock": threading.Lock(),
+        "_drain": threading.Event(),
+    }
+    mon = RunMonitor(test)
+    assert mon.supported
+    mon.start()
+    try:
+        for o in h:  # the run lands ops; the monitor tails them
+            with test["_history_lock"]:
+                test["_history"].append(o)
+            if test["_drain"].is_set():
+                break
+            time.sleep(0.001)
+        assert test["_drain"].wait(timeout=10)
+    finally:
+        mon.stop()
+    assert mon.aborted
+    assert "G1c" in test["_online_abort"]["anomaly-types"]
+    assert test["_online_abort"]["op-count"] < len(h)
+
+
+def test_run_monitor_unsupported_checker_is_noop():
+    from jepsen_tpu.online.monitor import RunMonitor
+
+    test = {"checker": object(), "online": True,
+            "_history": [], "_history_lock": threading.Lock(),
+            "_drain": threading.Event()}
+    mon = RunMonitor(test).start()
+    mon.stop()
+    assert not mon.supported and not mon.aborted
+
+
+# ---------------------------------------------------------------------------
+# watch CLI
+
+def _run_watch_cli(argv):
+    from jepsen_tpu.cli import run_cli, watch_cmd
+
+    return run_cli(watch_cmd(), ["watch"] + argv)
+
+
+def test_watch_cli_edn_fixture_exit_codes(tmp_path, capsys):
+    ok = os.path.join(FIXTURES, "list_append_valid.edn")
+    bad = os.path.join(FIXTURES, "list_append_g1c.edn")
+    assert _run_watch_cli([ok, "--window", "16"]) == 0
+    out = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l]
+    assert out and out[-1]["valid"] is True
+    assert _run_watch_cli([bad, "--window", "16"]) == 1
+    out = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l]
+    assert out[-1]["valid"] is False
+    assert "G1c" in out[-1]["anomaly-types"]
+
+
+def test_watch_cli_register_workload(capsys):
+    p = os.path.join(FIXTURES, "cas_register_keyed.edn")
+    assert _run_watch_cli([p, "--workload", "register",
+                           "--window", "20"]) == 0
+    out = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l]
+    assert out[-1]["valid"] is True
+
+
+def test_watch_cli_state_dir_dedup(tmp_path, capsys):
+    p = os.path.join(FIXTURES, "list_append_valid.edn")
+    sd = str(tmp_path / "state")
+    assert _run_watch_cli([p, "--window", "16", "--state-dir", sd]) == 0
+    first = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert first
+    assert _run_watch_cli([p, "--window", "16", "--state-dir", sd]) == 0
+    second = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert second == []  # every boundary replayed from the verdict log
+    assert os.path.exists(os.path.join(sd, "verdicts.jsonl"))
+
+
+def test_watch_cli_unknown_workload_is_cli_error():
+    assert _run_watch_cli(["/nonexistent", "--workload", "nope"]) == 254
+
+
+# ---------------------------------------------------------------------------
+# serve-queue stream client
+
+def test_queue_stream_client_packs_windows(tmp_path):
+    from jepsen_tpu.history import op as to_op
+    from jepsen_tpu.online.client import QueueStreamClient
+    from jepsen_tpu.serve.queue import DurableQueue
+
+    hist = keyed_register_history(keys=3, n_ops=8, corrupt_key=1)
+    q = DurableQueue(str(tmp_path / "queue"))
+    c = QueueStreamClient(q, "stream-a", "register", window=24)
+    ids = c.stream(iter(hist))
+    assert len(ids) == (len(hist) + 23) // 24
+    assert c.consumed == len(hist)
+    # drain the queue the daemon's way: rehydrate + pack_check
+    spec = WORKLOAD_FACTORIES["register"]()
+    batch = q.take_batch()
+    assert [j["id"] for j in batch] == ids
+    jobs = [[spec["rehydrate"](to_op(d)) for d in j["history"]]
+            for j in batch]
+    verdicts = indep.pack_check(spec["checker"], {"name": "q"}, jobs)
+    for j, v in zip(batch, verdicts):
+        q.commit(j["id"], v)
+    # the last window snapshot IS the full stream: its queued verdict
+    # agrees with a one-shot check of the whole history
+    final = c.final_verdict(timeout=5)
+    one_shot = spec["checker"].check({"name": "q"}, jobs[-1], {})
+    # the queue persists verdicts as JSON, so compare in JSON space
+    one_shot_json = json.loads(json.dumps(store._json_keys(one_shot),
+                                          default=store._json_default))
+    assert strip_supervision(final) == strip_supervision(one_shot_json)
+    assert final["valid"] is False
+    assert final["failures"] == [1]

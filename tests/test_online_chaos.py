@@ -1,0 +1,130 @@
+"""Chaos test for the streaming checker: SIGKILL a live
+`watch --follow` mid-stream, resume it, and require exactly-once
+verdict emission with a final verdict bit-identical to the batch
+checker over the full WAL."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import store
+from jepsen_tpu.online.stream import VERDICT_LOG_FILE
+from jepsen_tpu.serve.registry import WORKLOAD_FACTORIES
+from jepsen_tpu.workloads import list_append
+
+pytestmark = [pytest.mark.online, pytest.mark.chaos]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WINDOW = 16
+N_OPS = 160
+
+
+def _spawn_watch(wal, state_dir):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.Popen(
+        [sys.executable, "-m", "tests.watch_chaos_driver", wal,
+         "--follow", "--state-dir", state_dir,
+         "--window", str(WINDOW), "--max-ops", str(N_OPS),
+         "--poll", "0.01"],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+
+
+def _append_wal(wal, ops, epoch):
+    with open(wal, "a") as f:
+        for o in ops:
+            f.write(json.dumps({**o.to_dict(), "_epoch": epoch}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _log_entries(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass  # torn tail from the kill — load-tolerated
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def _wait_for_entries(path, n, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = _log_entries(path)
+        if len(got) >= n:
+            return got
+        time.sleep(0.02)
+    raise AssertionError(
+        f"verdict log never reached {n} entries: {_log_entries(path)}")
+
+
+def test_watch_follow_sigkill_resume_exactly_once(tmp_path):
+    h = list_append.simulate(N_OPS, seed=21, inject=())
+    assert len(h) >= N_OPS
+    h = h[:N_OPS]
+    wal = str(tmp_path / store.WAL_FILE)
+    state_dir = str(tmp_path / "state")
+    log_path = os.path.join(state_dir, VERDICT_LOG_FILE)
+
+    # epoch 0 writer lands the first 50 ops, the live watch tails them
+    _append_wal(wal, h[:50], epoch=0)
+    child = _spawn_watch(wal, state_dir)
+    try:
+        before_kill = _wait_for_entries(log_path, 2)
+        os.kill(child.pid, signal.SIGKILL)
+    finally:
+        child.wait(timeout=30)
+        child.stdout.close()
+    killed_prefixes = [r["prefix"] for r in before_kill]
+    assert killed_prefixes == sorted(killed_prefixes)
+    assert set(killed_prefixes) <= {16, 32, 48}
+
+    # epoch 1 writer (a resumed run) lands the rest, then watch resumes
+    _append_wal(wal, h[50:], epoch=1)
+    child2 = _spawn_watch(wal, state_dir)
+    out, _ = child2.communicate(timeout=120)
+    assert child2.returncode == 0  # clean history: valid
+
+    # exactly-once: the resumed run re-emitted NOTHING the killed run
+    # already logged, and together they cover every window boundary
+    logged_at_kill = {r["prefix"] for r in _log_entries(log_path)
+                      if r["prefix"] in set(killed_prefixes)}
+    resumed = [json.loads(line) for line in out.splitlines() if line]
+    resumed_prefixes = [r["prefix"] for r in resumed]
+    assert not (set(resumed_prefixes) & logged_at_kill)
+    final_log = _log_entries(log_path)
+    prefixes = [r["prefix"] for r in final_log]
+    assert sorted(prefixes) == list(range(WINDOW, N_OPS + 1, WINDOW))
+    assert len(prefixes) == len(set(prefixes))  # no duplicates
+    assert set(killed_prefixes) | set(resumed_prefixes) == set(prefixes)
+
+    # the final logged verdict is bit-identical to the batch checker
+    # over the full WAL (modulo supervision telemetry + JSON space)
+    (final,) = [r for r in final_log if r["prefix"] == N_OPS]
+    batch = WORKLOAD_FACTORIES["cycle"]()["checker"].check(
+        {"name": "chaos"}, store.follow_wal(wal), {})
+    batch_json = json.loads(json.dumps(store._json_keys(batch),
+                                       default=store._json_default))
+
+    def strip(v):
+        if isinstance(v, dict):
+            return {k: strip(x) for k, x in v.items()
+                    if k != "supervision"}
+        if isinstance(v, list):
+            return [strip(x) for x in v]
+        return v
+
+    assert strip(final["verdict"]) == strip(batch_json)
+    assert final["verdict"]["valid"] is True

@@ -1,0 +1,27 @@
+"""Subprocess driver for the watch SIGKILL → resume chaos test
+(tests/test_online_chaos.py). Runnable as:
+
+    python -m tests.watch_chaos_driver <watch-args...>
+
+It is exactly the `jepsen-tpu watch` subcommand — a separate module so
+the chaos test can spawn, SIGKILL, and respawn a real watch process
+(same pattern as tests/fuzz_chaos_driver.py). The crash-safety claim
+under test lives in online/stream.py: every emitted verdict is fsync'd
+to the state dir's verdict log BEFORE it prints, and a resumed session
+re-derives the same deterministic window boundaries, so the union of
+the killed and resumed runs' emissions is exactly the uninterrupted
+run's — no duplicates, no gaps."""
+
+from __future__ import annotations
+
+import sys
+
+from jepsen_tpu.cli import run_cli, watch_cmd
+
+
+def main(argv) -> int:
+    return run_cli(watch_cmd(), ["watch"] + list(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

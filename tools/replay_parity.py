@@ -576,6 +576,109 @@ def replay_resume() -> dict:
     return out
 
 
+def replay_online() -> dict:
+    """Streaming-checker parity (the online package): the incremental
+    cycle frontier and the windowed WGL frontier must return verdicts
+    identical to their batch checkers on EVERY checked prefix of
+    seeded histories — that is the subsystem's core contract, so it
+    replays here, not just in the unit suite. The committed EDN
+    fixture corpus replays through the ingest adapters too: each
+    fixture's streamed verdict must match its recorded expectation AND
+    the batch verdict over the same ingested ops."""
+    from jepsen_tpu import store
+    from jepsen_tpu.checker import cycle
+    from jepsen_tpu.history import index
+    from jepsen_tpu.independent import tuple_
+    from jepsen_tpu.online import CycleFrontier, WGLFrontier, iter_trace
+    from jepsen_tpu.serve.registry import WORKLOAD_FACTORIES
+    from jepsen_tpu.workloads import list_append
+
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    import helpers
+
+    t0 = time.monotonic()
+    out: dict = {"cycle_prefixes": 0, "wgl_prefixes": 0,
+                 "fixtures": 0, "mismatches": [], "failures": 0}
+
+    def norm(v):
+        return _strip_supervision(json.loads(json.dumps(
+            store._json_keys(v), default=store._json_default)))
+
+    # incremental cycle frontier vs CycleChecker.check, prefix by prefix
+    for seed, inject in ((11, ()), (7, ("G1c",)),
+                         (3, ("G1c", "G-single"))):
+        name = f"list-append-400-s{seed}-{'+'.join(inject) or 'clean'}"
+        try:
+            hist = list_append.simulate(400, seed=seed, inject=inject)
+            chk = cycle.checker(engine="host")
+            f = CycleFrontier(chk)
+            for cut in (64, 150, 333, len(hist)):
+                f.extend(hist[len(f.ops):cut])
+                out["cycle_prefixes"] += 1
+                if norm(f.advance()) != norm(chk.check({}, hist[:cut], {})):
+                    out["mismatches"].append(
+                        {"case": name, "prefix": cut, "kind": "cycle"})
+                    log(f"  online: cycle frontier diverges on {name} "
+                        f"at prefix {cut}")
+        except Exception as e:  # noqa: BLE001 — counted, not fatal
+            out["failures"] += 1
+            log(f"  online: {name} failed ({e!r}); counted")
+
+    # windowed WGL frontier vs IndependentChecker.check
+    try:
+        hist = []
+        for k in range(5):
+            for o in helpers.random_register_history(
+                    n_process=3, n_ops=10, n_values=3, cas=True,
+                    corrupt=0.4 if k == 3 else 0.0, seed=700 + k):
+                hist.append(o.with_(value=tuple_(k, o.value)))
+        hist = index(hist)
+        chk = WORKLOAD_FACTORIES["register"]()["checker"]
+        test = {"name": "online-replay"}
+        f = WGLFrontier(chk, test=test)
+        for cut in (17, 60, 101, len(hist)):
+            f.extend(hist[len(f.ops):cut])
+            out["wgl_prefixes"] += 1
+            if norm(f.advance()) != norm(chk.check(test, hist[:cut], {})):
+                out["mismatches"].append(
+                    {"case": "keyed-register-5x10", "prefix": cut,
+                     "kind": "wgl"})
+                log(f"  online: wgl frontier diverges at prefix {cut}")
+    except Exception as e:  # noqa: BLE001
+        out["failures"] += 1
+        log(f"  online: wgl replay failed ({e!r}); counted")
+
+    # committed EDN fixtures through the ingest adapters
+    fixtures_dir = os.path.join(ROOT, "tests", "fixtures", "edn")
+    try:
+        with open(os.path.join(fixtures_dir, "expected.json")) as fh:
+            expected = json.load(fh)
+        for fname, exp in sorted(expected.items()):
+            out["fixtures"] += 1
+            ops = list(iter_trace(os.path.join(fixtures_dir, fname)))
+            spec = WORKLOAD_FACTORIES[exp["workload"]]()
+            if spec.get("rehydrate"):
+                ops = [spec["rehydrate"](o) for o in ops]
+            r = spec["checker"].check({"name": "fixture"}, ops, {})
+            if (r["valid"] != exp["valid"]
+                    or (r.get("anomaly-types") or []) !=
+                    exp["anomaly-types"]):
+                out["mismatches"].append(
+                    {"case": fname, "kind": "fixture",
+                     "expected": exp,
+                     "got": [r["valid"], r.get("anomaly-types")]})
+                log(f"  online: fixture {fname} verdict drifted")
+    except Exception as e:  # noqa: BLE001
+        out["failures"] += 1
+        log(f"  online: fixture replay failed ({e!r}); counted")
+
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = (not out["mismatches"] and not out["failures"]
+                 and out["cycle_prefixes"] > 0 and out["wgl_prefixes"] > 0
+                 and out["fixtures"] > 0)
+    return out
+
+
 def replay_fuzz() -> dict:
     """Fuzz-corpus parity: every committed discovered-anomaly trace
     (tests/fixtures/fuzz_anomalies.jsonl, a real fixed-seed fuzz run —
@@ -700,9 +803,13 @@ def main(argv=None) -> int:
     fuzz_out = replay_fuzz()
     log(f"  fuzz: {fuzz_out}")
 
+    log("replaying online streaming frontiers ...")
+    online_out = replay_online()
+    log(f"  online: {online_out}")
+
     ok = (all(not e.get("mismatches") for e in engines.values())
           and cycle_out["ok"] and mesh_out["ok"] and resume_out["ok"]
-          and fuzz_out["ok"])
+          and fuzz_out["ok"] and online_out["ok"])
     # supervision telemetry (per-engine failure kinds, demotions,
     # breaker trips) for any checks that routed through the supervisor
     # during the replay — zeros on a healthy run
@@ -722,6 +829,7 @@ def main(argv=None) -> int:
         "mesh": mesh_out,
         "resume": resume_out,
         "fuzz": fuzz_out,
+        "online": online_out,
         "supervision": supervision,
         "ok": ok,
     }
