@@ -1165,7 +1165,6 @@ def bench_serve_daemon(run_seed: int) -> dict:
         v = q.wait_for_verdict(jid, timeout=600)
         assert v is not None and v.get("valid") is good, (jid, good, v)
     elapsed = time.monotonic() - t0
-    dm.draining.set()
     out["sustained"] = {
         "histories": len(ids),
         "ops": total_ops,
@@ -1173,6 +1172,51 @@ def bench_serve_daemon(run_seed: int) -> dict:
         "ops_per_s": round(total_ops / elapsed, 1),
     }
     log(f"serve_daemon sustained: {out['sustained']}")
+
+    # deadline overhead: the same shape of work submitted WITH a
+    # generous deadline_ms runs the per-job deadline path (individual
+    # checks, budget plumbed into the supervisor) instead of the
+    # packed batch path — the gap is what deadline propagation costs
+    # when deadlines never actually fire
+    from jepsen_tpu.checker import supervisor as sup_mod
+
+    dl_ops = 0
+    dl_ids, dl_expected = [], []
+    exp0 = sup_mod.get().telemetry.snapshot().get("deadline_expired", 0)
+    t0 = time.monotonic()
+    for i in range(20):
+        good = rng.random() < 0.8
+        key = f"dl{i}"
+        hist = []
+        for t2, val in ((0, 1), (2, 2), (4, 3)):
+            hist.append({"process": 0, "type": "invoke", "f": "write",
+                         "value": [key, val], "time": t2})
+            hist.append({"process": 0, "type": "ok", "f": "write",
+                         "value": [key, val], "time": t2 + 1})
+        read = 3 if good else 99
+        hist.append({"process": 0, "type": "invoke", "f": "read",
+                     "value": [key, None], "time": 6})
+        hist.append({"process": 0, "type": "ok", "f": "read",
+                     "value": [key, read], "time": 7})
+        dl_ops += len(hist)
+        dl_expected.append(good)
+        dl_ids.append(q.submit(f"client-{i % 5}", "register", hist,
+                               deadline_ms=120_000))
+    for jid, good in zip(dl_ids, dl_expected):
+        v = q.wait_for_verdict(jid, timeout=600)
+        assert v is not None and v.get("valid") is good, (jid, good, v)
+    dl_elapsed = time.monotonic() - t0
+    dm.draining.set()
+    out["deadline_overhead"] = {
+        "histories": len(dl_ids),
+        "ops": dl_ops,
+        "wall_s": round(dl_elapsed, 3),
+        "ops_per_s": round(dl_ops / dl_elapsed, 1),
+        "deadline_expired":
+            sup_mod.get().telemetry.snapshot().get("deadline_expired", 0)
+            - exp0,
+    }
+    log(f"serve_daemon deadline_overhead: {out['deadline_overhead']}")
     return out
 
 

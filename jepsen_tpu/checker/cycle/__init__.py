@@ -88,14 +88,22 @@ class CycleChecker(Checker):
         history = [self._unwrap(o) for o in _ops(history)]
         sup = sup_mod.get_closure()
         snap0 = sup.telemetry.snapshot()
+        budget = (test or {}).get("deadline")
         try:
             g = self.graph(history, key=opts.get("history_key"))
             r = classify(g, self.anomalies, realtime=self.realtime,
                          engine=self.engine,
                          max_witnesses=self.max_witnesses,
-                         journal=(test or {}).get("_analysis_journal"))
+                         journal=(test or {}).get("_analysis_journal"),
+                         budget=None if budget is None else float(budget))
         except IllegalInference as e:
             return {"valid": "unknown", "error": e.info}
+        except sup_mod.EngineFailure as e:
+            if e.kind != "deadline":
+                raise
+            # the client's deadline expired mid-closure: completed
+            # components are journaled, so a retry salvages them
+            return {"valid": "unknown", "error": "deadline"}
         out = {"valid": not r["anomaly-types"], **r}
         delta = sup_mod.Telemetry.delta(snap0, sup.telemetry.snapshot())
         if any(k != "calls" for k in delta):

@@ -104,10 +104,12 @@ def _unpack_closure(d) -> np.ndarray:
     return np.unpackbits(bits, count=n * n).astype(bool).reshape(n, n)
 
 
-def _closures(mats, engine=None) -> list:
+def _closures(mats, engine=None, budget=None) -> list:
     """Closure of every matrix, through the supervised ladder by
     default or a pinned engine ("host" / "tpu" / "mesh") for parity
-    tooling."""
+    tooling. ``budget`` (absolute time.monotonic deadline) rides the
+    supervised path only — pinned engines are parity tools and run to
+    completion."""
     if not mats:
         return []
     if engine == "host":
@@ -123,8 +125,12 @@ def _closures(mats, engine=None) -> list:
     from .. import supervisor as sup_mod
 
     sup = sup_mod.get_closure()
+    # expired lanes resolve to None (an under-approximate closure
+    # would silently hide anomalies); callers treat None as
+    # deadline-expired and degrade that trace to unknown
     return sup.run(None, mats, ladder=sup_mod.CLOSURE_LADDER,
-                   on_exhausted="raise")
+                   budget=budget, on_exhausted="raise",
+                   expired_fill=lambda: None)
 
 
 def _witness(g: DepGraph, comp, allowed, a, b) -> dict:
@@ -155,7 +161,8 @@ def _witness(g: DepGraph, comp, allowed, a, b) -> dict:
 
 
 def classify(g: DepGraph, anomalies=ANOMALIES, *, realtime=False,
-             engine=None, max_witnesses=4, journal=None) -> dict:
+             engine=None, max_witnesses=4, journal=None,
+             budget=None) -> dict:
     """Find every requested anomaly in a dependency graph.
 
     Returns {"anomaly-types": [...], "anomalies": {type: [witness]},
@@ -167,7 +174,12 @@ def classify(g: DepGraph, anomalies=ANOMALIES, *, realtime=False,
     resumable: each component x mask job is keyed by content hash, a
     journaled closure is reused (counted in the closure supervisor's
     journal_skips telemetry) and only the remaining jobs go to the
-    engine; completed closures journal as packed bitmaps."""
+    engine; completed closures journal as packed bitmaps.
+
+    budget (absolute time.monotonic deadline) bounds the closure
+    step's wall clock; expiry raises EngineFailure(kind="deadline") —
+    closures that DID complete are journaled first, so a retry with a
+    fresh budget only computes the remainder."""
     for a in anomalies:
         if a not in _MASKS:
             raise ValueError(f"unknown anomaly {a!r} "
@@ -212,10 +224,16 @@ def classify(g: DepGraph, anomalies=ANOMALIES, *, realtime=False,
     # buried behind a run of singletons. Results realign by index.
     todo.sort(key=lambda i: -mats[i].shape[0])
     for i, sub in zip(todo, _closures([mats[i] for i in todo],
-                                      engine=engine)):
+                                      engine=engine, budget=budget)):
         closed[i] = sub
-        if journal is not None:
+        if sub is not None and journal is not None:
             journal.record("closure", jkeys[i], _pack_closure(sub))
+    if any(x is None for x in closed):
+        from .. import supervisor as sup_mod
+
+        raise sup_mod.EngineFailure(
+            "closure", "deadline",
+            "closure budget expired before every component closed")
     # reassemble per-mask full-size closure (block-diagonal by
     # construction: no path leaves a weak component)
     closure = {rels: np.zeros((n, n), dtype=bool) for rels in keys}

@@ -181,7 +181,9 @@ def _combine_lanes(rs: list):
     """One WGLResult for a P-compositionally decomposed history: valid
     iff every lane is (locality — ops/pcomp.py); an invalid lane's
     counterexample is the history's counterexample (its ops are real
-    ops of the full history); step counts sum."""
+    ops of the full history); step counts sum. An unknown lane's error
+    tag (e.g. "deadline" from a budget-expired chunk) survives the
+    combine so callers can tell WHY the verdict degraded."""
     steps = sum(getattr(r, "steps", 0) or 0 for r in rs)
     for r in rs:
         if r.valid is False:
@@ -189,7 +191,12 @@ def _combine_lanes(rs: list):
                 valid=False, op=r.op,
                 best_linearization=r.best_linearization, steps=steps)
     if any(r.valid == "unknown" for r in rs):
-        return wgl_host.WGLResult(valid="unknown", steps=steps)
+        out = wgl_host.WGLResult(valid="unknown", steps=steps)
+        for r in rs:
+            if r.valid == "unknown" and getattr(r, "error", None):
+                out.error = r.error  # type: ignore[attr-defined]
+                break
+        return out
     return wgl_host.WGLResult(valid=True, steps=steps)
 
 
@@ -210,12 +217,23 @@ class Linearizable(Checker):
             raise ValueError("linearizable checker needs a model")
         return m
 
+    @staticmethod
+    def _budget(test):
+        """The caller's absolute-monotonic verdict budget, when one is
+        stamped on the test (`test["deadline"]` — the serve daemon's
+        deadline_ms plumbing and the watch window budget). None on the
+        default contract, keeping every no-deadline path bit-identical
+        to before budgets existed."""
+        b = (test or {}).get("deadline")
+        return None if b is None else float(b)
+
     def check(self, test, history, opts=None) -> dict:
         from . import supervisor as sup_mod
 
         sup = sup_mod.get()
         snap0 = sup.telemetry.snapshot()
         model = self._model(test)
+        budget = self._budget(test)
         history = list(history)  # may be a one-shot iterator; used twice
         es = make_entries(history)
         algorithm = self.algorithm
@@ -233,7 +251,7 @@ class Linearizable(Checker):
                 if lanes is not None:
                     rs = self._component_results(
                         lanes, self._steps_budget(),
-                        deadline=self._deadline())
+                        deadline=self._deadline(), budget=budget)
                     d = self._result(_combine_lanes(rs))
                     self._attach_supervision(d, sup, snap0)
                     self._render_invalid(test, history, d, opts)
@@ -260,7 +278,8 @@ class Linearizable(Checker):
             (r,) = sup.run(
                 model, [es], time_limit=self.time_limit,
                 ladder=_LADDERS[algorithm],
-                deadline=self._watchdog(sup), on_exhausted="raise")
+                deadline=self._watchdog(sup), budget=budget,
+                on_exhausted="raise")
         elif algorithm == "competition":
             d = self._competition(model, es)
             self._attach_supervision(d, sup, snap0)
@@ -345,6 +364,7 @@ class Linearizable(Checker):
                         d["supervision"] = delta
 
         algorithm = self.algorithm
+        budget = self._budget(test)
         batch_kw = self._steps_budget()
         if algorithm in ("pallas", "tpu"):
             # supervised batch: a mid-batch engine failure demotes the
@@ -352,7 +372,7 @@ class Linearizable(Checker):
             # never aborts the whole batch (on_exhausted="unknown")
             for i, r in enumerate(sup.run(
                     model, ess, ladder=_LADDERS[algorithm],
-                    deadline=self._watchdog(sup),
+                    deadline=self._watchdog(sup), budget=budget,
                     on_exhausted="unknown", **batch_kw)):
                 finish(i, r)
             attach_all()
@@ -383,13 +403,15 @@ class Linearizable(Checker):
                 flat.extend(lanes)
             if ok:
                 rs = self._component_results(flat, batch_kw,
-                                             deadline=self._deadline())
+                                             deadline=self._deadline(),
+                                             budget=budget)
                 for i, (a, b) in enumerate(spans):
                     finish(i, _combine_lanes(rs[a:b]))
                 attach_all()
                 return results
 
-        for i, r in enumerate(self._auto_results(model, ess, batch_kw)):
+        for i, r in enumerate(self._auto_results(model, ess, batch_kw,
+                                                 budget=budget)):
             finish(i, r)
         attach_all()
         return results
@@ -416,7 +438,8 @@ class Linearizable(Checker):
                 else _t.monotonic() + self.time_limit)
 
     def _component_results(self, comp_lanes, batch_kw,
-                           deadline: float | None = None) -> list:
+                           deadline: float | None = None,
+                           budget: float | None = None) -> list:
         """WGLResults for a flat list of (sub_model, Entries) component
         lanes (pcomp.split output), batched per DISTINCT sub-model —
         the engines take one model per batch call (grouping shared
@@ -428,13 +451,14 @@ class Linearizable(Checker):
         for m, idxs in pcomp.group_lanes(comp_lanes).items():
             rs = self._auto_results(
                 m, [comp_lanes[i][1] for i in idxs], batch_kw,
-                deadline=deadline)
+                deadline=deadline, budget=budget)
             for i, r in zip(idxs, rs):
                 out[i] = r
         return out
 
     def _auto_results(self, model, ess, batch_kw,
-                      deadline: float | None = None) -> list:
+                      deadline: float | None = None,
+                      budget: float | None = None) -> list:
         """The batched "auto" engine policy as raw WGLResults: batches
         at/past the measured pallas crossover go straight to the
         pallas engine; below it, native triage + native finish, with
@@ -470,7 +494,7 @@ class Linearizable(Checker):
             # in-kernel (PASS1_CAP + dense survivor repack).
             return list(sup.run(
                 model, ess, ladder=_LADDERS["pallas"], deadline=wd,
-                on_exhausted="unknown", **batch_kw))
+                budget=budget, on_exhausted="unknown", **batch_kw))
         out: list = [None] * n
         if not sup.healthy("native"):
             # quarantined by the breaker: route around it entirely
@@ -514,10 +538,14 @@ class Linearizable(Checker):
 
         def lane_limit():
             """Per-lane wall limit: the shared deadline's remainder
-            when one exists, else the full per-lane time_limit."""
-            if deadline is None:
-                return self.time_limit
-            return max(0.001, deadline - _t.monotonic())
+            when one exists, else the full per-lane time_limit — in
+            either case capped by the client budget's remainder."""
+            lim = (self.time_limit if deadline is None
+                   else max(0.001, deadline - _t.monotonic()))
+            if budget is not None:
+                rem = max(0.001, budget - _t.monotonic())
+                lim = rem if lim is None else min(lim, rem)
+            return lim
 
         hard = [i for i in pending if native_ok[i]]
         rest = [i for i in pending if not native_ok[i]]
@@ -541,7 +569,8 @@ class Linearizable(Checker):
             for i, r in zip(hard, sup.run(
                     model, [ess[i] for i in hard],
                     time_limit=lane_limit(), ladder=("native", "host"),
-                    deadline=wd, on_exhausted="unknown")):
+                    deadline=wd, budget=budget,
+                    on_exhausted="unknown")):
                 out[i] = r
         if rest:
             sub = [ess[i] for i in rest]
@@ -550,16 +579,16 @@ class Linearizable(Checker):
                              and _pallas_eligible(model, sub))
             if pallas_ok:
                 rs = sup.run(model, sub, ladder=("pallas", "tpu", "host"),
-                             deadline=wd, on_exhausted="unknown",
-                             **batch_kw)
+                             deadline=wd, budget=budget,
+                             on_exhausted="unknown", **batch_kw)
             elif all(_tpu_eligible(model, es) for es in sub):
                 rs = sup.run(model, sub, ladder=("tpu", "host"),
-                             deadline=wd, on_exhausted="unknown",
-                             **batch_kw)
+                             deadline=wd, budget=budget,
+                             on_exhausted="unknown", **batch_kw)
             else:
                 rs = sup.run(model, sub, ladder=("host",),
                              time_limit=lane_limit(), deadline=wd,
-                             on_exhausted="unknown")
+                             budget=budget, on_exhausted="unknown")
             for i, r in zip(rest, rs):
                 out[i] = r
         return out
@@ -675,6 +704,10 @@ class Linearizable(Checker):
         configs = getattr(r, "configs", None)
         if configs:
             d["configs"] = configs[:TRUNCATE]
+        if r.valid == "unknown" and getattr(r, "error", None):
+            # why the verdict degraded ("deadline" from a budget-
+            # expired chunk, a competition loser's exception text)
+            d["error"] = r.error
         d["cache_size"] = r.cache_size
         d["steps"] = r.steps
         return d

@@ -74,7 +74,7 @@ LADDER = ("pallas", "wgl_mesh", "tpu", "native", "host")
 COUNTERS = (
     "calls", "retries", "demotions", "breaker_trips", "salvaged_chunks",
     "timeouts", "bisections", "engine_failures", "probe_failures",
-    "exhausted", "journal_skips",
+    "exhausted", "journal_skips", "deadline_expired",
 )
 
 # Threads abandoned by watchdog timeouts: same discipline as the
@@ -95,7 +95,8 @@ class EngineFailure(Exception):
     """An engine call failed after supervision gave up on it.
 
     kind is the final classification: "oom", "timeout", "transient",
-    "unavailable", or "fatal"."""
+    "unavailable", "deadline" (the caller's budget expired — retrying
+    or demoting cannot help), or "fatal"."""
 
     def __init__(self, engine: str, kind: str, cause=None):
         super().__init__(f"{engine} failed ({kind}): {cause}")
@@ -224,15 +225,29 @@ class CircuitBreaker:
         self._lock = threading.Lock()
         self._consec: dict[str, int] = {}
         self._open_until: dict[str, float] = {}
+        # engine -> (claimant thread id, claim expiry): who holds the
+        # half-open probe slot once the cool-down has elapsed
+        self._half_open: dict[str, tuple] = {}
 
     def healthy(self, engine: str) -> bool:
         with self._lock:
             until = self._open_until.get(engine)
             if until is None:
                 return True
-            if self.clock() >= until:
-                # half-open: allow one attempt; a failure re-trips
-                del self._open_until[engine]
+            now = self.clock()
+            if now < until:
+                return False
+            # half-open: the quarantine stays recorded until a probe
+            # resolves it, and exactly ONE caller wins the probe slot
+            # — concurrent callers racing the cool-down expiry must
+            # not all hammer a possibly-still-dead engine. The claim
+            # expires after one cool-down so a claimant that died
+            # mid-probe cannot wedge routing forever; the same thread
+            # may re-consult its own claim (retry loops re-check).
+            tid = threading.get_ident()
+            claim = self._half_open.get(engine)
+            if claim is None or claim[0] == tid or now >= claim[1]:
+                self._half_open[engine] = (tid, now + self.cooldown)
                 return True
             return False
 
@@ -240,13 +255,22 @@ class CircuitBreaker:
         with self._lock:
             self._consec[engine] = 0
             self._open_until.pop(engine, None)
+            self._half_open.pop(engine, None)
 
     def record_failure(self, engine: str) -> bool:
         """Count a failure; returns True when this one TRIPS the
-        breaker (closed -> open)."""
+        breaker (closed -> open, or a failed half-open probe
+        re-tripping)."""
         with self._lock:
             n = self._consec.get(engine, 0) + 1
             self._consec[engine] = n
+            until = self._open_until.get(engine)
+            if until is not None and self.clock() >= until:
+                # the half-open probe failed: re-trip for a full
+                # cool-down and free the probe slot
+                self._open_until[engine] = self.clock() + self.cooldown
+                self._half_open.pop(engine, None)
+                return True
             if n >= self.threshold and engine not in self._open_until:
                 self._open_until[engine] = self.clock() + self.cooldown
                 return True
@@ -259,6 +283,7 @@ class CircuitBreaker:
                 self.threshold, self._consec.get(engine, 0))
             self._open_until[engine] = self.clock() + (
                 self.cooldown if cooldown is None else cooldown)
+            self._half_open.pop(engine, None)
 
     def state(self) -> dict:
         with self._lock:
@@ -603,18 +628,37 @@ class Supervisor:
         return box["result"]
 
     def call(self, engine: str, model, ess, max_steps=None,
-             time_limit=None, deadline: float | None = None) -> list:
+             time_limit=None, deadline: float | None = None,
+             budget: float | None = None) -> list:
         """One supervised engine call over `ess`: deadline + retries +
         OOM bisection. Success resets the breaker; exhaustion raises
-        EngineFailure (callers demote). Results align with `ess`."""
+        EngineFailure (callers demote). Results align with `ess`.
+
+        `budget` is an absolute monotonic instant the CLIENT's
+        deadline expires at (vs `deadline`, the wedge-catching
+        watchdog): the watchdog is capped at budget + deadline_grace,
+        and a retry that would start past the budget is skipped —
+        backoff sleeps can't blow a deadline the caller promised. A
+        budget-expired call raises EngineFailure(kind="deadline")."""
         run = self.registry[engine]
         c = self.config
         if deadline is None and c.call_timeout is not None:
             deadline = time.monotonic() + c.call_timeout
+        if budget is not None:
+            if time.monotonic() >= budget:
+                self.telemetry.record("deadline_expired")
+                raise EngineFailure(engine, "deadline",
+                                    "budget expired before the call")
+            bd = budget + c.deadline_grace
+            deadline = bd if deadline is None else min(deadline, bd)
         last = None
         kind = "transient"
         for attempt in range(c.max_retries + 1):
             if attempt:
+                if budget is not None and time.monotonic() >= budget:
+                    kind = "deadline"
+                    self.telemetry.record("deadline_expired")
+                    break
                 self.telemetry.record("retries")
                 self._sleep_backoff(attempt - 1)
             self.telemetry.record("calls")
@@ -652,11 +696,12 @@ class Supervisor:
                     return (self.call(engine, model, ess[:mid],
                                       max_steps=max_steps,
                                       time_limit=time_limit,
-                                      deadline=deadline)
+                                      deadline=deadline, budget=budget)
                             + self.call(engine, model, ess[mid:],
                                         max_steps=max_steps,
                                         time_limit=time_limit,
-                                        deadline=deadline))
+                                        deadline=deadline,
+                                        budget=budget))
                 if not self.breaker.healthy(engine):
                     break  # quarantined mid-loop: stop hammering it
         raise EngineFailure(engine, kind, last) from last
@@ -673,15 +718,33 @@ class Supervisor:
 
     def run(self, model, ess, max_steps=None, time_limit=None,
             ladder=LADDER, deadline: float | None = None,
-            on_exhausted: str = "unknown") -> list:
+            budget: float | None = None,
+            on_exhausted: str = "unknown",
+            expired_fill=None) -> list:
         """Run a batch down the degradation ladder in supervision
         chunks. Each chunk starts at the first healthy+eligible rung
         and demotes on failure; completed chunks keep their verdicts
         (salvage). `on_exhausted` decides what happens when a chunk
         falls off the ladder: "unknown" (never abort a batch — the
         auto policy) or "raise" (explicit-algorithm checks, where
-        check_safe turns the error into an unknown verdict)."""
+        check_safe turns the error into an unknown verdict).
+
+        `budget` (absolute monotonic client deadline) threads into
+        every call; once it expires, the remaining chunks resolve to
+        `unknown` results tagged ``error="deadline"`` — completed
+        chunks keep their verdicts (P-compositional partial salvage)
+        and expiry NEVER raises, even under on_exhausted="raise".
+        `expired_fill` overrides what an expired lane resolves to (a
+        zero-arg callable; the closure ladder passes one, since its
+        results are matrices and a fabricated under-approximate
+        closure would silently hide anomalies)."""
         from ..ops import wgl_host
+
+        if expired_fill is None:
+            def expired_fill():
+                r = wgl_host.WGLResult(valid="unknown")
+                r.error = "deadline"
+                return r
 
         n = len(ess)
         if n == 0:
@@ -692,7 +755,16 @@ class Supervisor:
         out: list = [None] * n
         any_demotion = False
         clean_chunks = 0
+        expired = False
         for chunk in chunks:
+            if not expired and budget is not None \
+                    and time.monotonic() >= budget:
+                expired = True
+                self.telemetry.record("deadline_expired")
+            if expired:
+                for i in chunk:
+                    out[i] = expired_fill()
+                continue
             sub = [ess[i] for i in chunk]
             rs = None
             demoted_here = 0
@@ -719,13 +791,23 @@ class Supervisor:
                 try:
                     rs = self.call(rung, model, sub, max_steps=max_steps,
                                    time_limit=time_limit,
-                                   deadline=deadline)
+                                   deadline=deadline, budget=budget)
                     break
                 except EngineFailure as e:
+                    if e.kind == "deadline":
+                        # the budget ran out mid-walk: demoting can't
+                        # help — resolve this chunk (and, via the
+                        # pre-chunk check, the rest) as unknown
+                        expired = True
+                        break
                     last_err = e
                     demoted_here += 1
                     log.warning("demoting %d lanes below %s (%s)",
                                 len(sub), rung, e.kind)
+            if rs is None and expired:
+                for i in chunk:
+                    out[i] = expired_fill()
+                continue
             if rs is None:
                 self.telemetry.record("exhausted")
                 if on_exhausted == "raise":
@@ -829,7 +911,10 @@ def _env_config() -> SupervisorConfig:
     enables the subprocess first-compile probe (worth its ~seconds of
     child startup on real TPU fleets where a FATAL Mosaic abort costs
     the whole analysis); JEPSEN_TPU_SUP_TIMEOUT=<seconds> sets a hard
-    per-call watchdog."""
+    per-call watchdog; JEPSEN_TPU_SUP_GRACE=<seconds> sets the grace
+    the watchdog allows an engine past a client budget before
+    abandoning the call (deadline_grace — tests shrink it so expiry
+    bounds are tight)."""
     cfg = SupervisorConfig()
     if os.environ.get("JEPSEN_TPU_SUP_PROBE") == "1":
         cfg.probe_first_compile = True
@@ -839,6 +924,12 @@ def _env_config() -> SupervisorConfig:
             cfg.call_timeout = float(t)
         except ValueError:
             log.warning("ignoring non-numeric JEPSEN_TPU_SUP_TIMEOUT=%r", t)
+    g = os.environ.get("JEPSEN_TPU_SUP_GRACE")
+    if g:
+        try:
+            cfg.deadline_grace = float(g)
+        except ValueError:
+            log.warning("ignoring non-numeric JEPSEN_TPU_SUP_GRACE=%r", g)
     return cfg
 
 

@@ -432,6 +432,12 @@ def serve_cmd() -> dict:
             help="[daemon] Admission bound: reject submissions past N "
             "pending jobs (HTTP 429 + Retry-After)",
         )
+        p.add_argument(
+            "--max-attempts", type=int, default=None, metavar="N",
+            help="[daemon] Dead-letter bound: quarantine a job whose "
+            "check has crashed the worker N times, committing an "
+            "'unknown: quarantined' verdict (default 3)",
+        )
 
     def run(opts):
         from . import web
@@ -556,6 +562,12 @@ def fuzz_cmd() -> dict:
             "--fault-slots", type=int, default=None, metavar="N",
             help="Fault slots per schedule (default 8)",
         )
+        p.add_argument(
+            "--deadline-ms", type=int, default=None, metavar="MS",
+            help="Wall-clock budget per round's scoring launch: "
+            "traces whose closures don't fit score unknown (never "
+            "kept) instead of wedging the campaign",
+        )
 
     def run(opts):
         import json
@@ -573,6 +585,7 @@ def fuzz_cmd() -> dict:
             "keys": opts.get("keys"),
             "txns": opts.get("txns"),
             "fault_slots": opts.get("fault_slots"),
+            "deadline_ms": opts.get("deadline_ms"),
         })
         print(json.dumps(summary, indent=2, sort_keys=True))
         return 0
@@ -624,6 +637,12 @@ def watch_cmd() -> dict:
         p.add_argument(
             "--poll", type=float, default=0.05, metavar="SECONDS",
             help="Tail poll interval")
+        p.add_argument(
+            "--deadline-ms", type=int, default=None, metavar="MS",
+            help="Wall-clock budget per verdict window: keys that "
+            "don't fit get 'unknown: deadline' this window and are "
+            "retried on the next, so one slow window never stalls the "
+            "stream")
 
     def run(opts):
         from .online.watch import run_watch

@@ -149,7 +149,7 @@ class FuzzLoop:
     def __init__(self, corpus_dir: str, spec: SimSpec = DEFAULT_SPEC,
                  seed: int = 0, clusters: int = 256, families=None,
                  engine: str | None = None, score_engine: str | None = None,
-                 round_hook=None):
+                 round_hook=None, score_budget_s: float | None = None):
         if clusters < 2:
             raise ValueError("clusters must be >= 2")
         self.corpus = Corpus(corpus_dir, spec, seed)
@@ -160,6 +160,10 @@ class FuzzLoop:
         self.engine = engine if engine is not None else env_engine()
         self.score_engine = score_engine
         self.round_hook = round_hook
+        # wall-clock bound per round's scoring launch: traces whose
+        # closures don't fit score unknown (never kept in the corpus)
+        # instead of wedging the whole campaign
+        self.score_budget_s = score_budget_s
 
     # -- population -------------------------------------------------------
 
@@ -234,8 +238,13 @@ class FuzzLoop:
         wseeds = np.array([p[0] for p in pop], dtype=np.int64)
         results = simulate_batch(scheds, wseeds, self.spec,
                                  engine=self.engine)
+        budget = None
+        if self.score_budget_s is not None:
+            import time
+
+            budget = time.monotonic() + self.score_budget_s
         scores = score_batch(results, self.spec, scheds=scheds,
-                             engine=self.score_engine)
+                             engine=self.score_engine, budget=budget)
         stats = self._fold(rnd, pop, scores)
         if self.round_hook is not None:
             self.round_hook(rnd)
@@ -270,11 +279,14 @@ def run_fuzz(opts: dict) -> dict:
         if bad:
             raise ValueError(f"unknown fault families: {bad} "
                              f"(known: {list(FAMILIES)})")
+    deadline_ms = opts.get("deadline_ms")
     loop = FuzzLoop(
         opts["corpus_dir"], spec=spec,
         seed=int(opts.get("seed") or 0),
         clusters=int(opts.get("clusters") or 256),
         families=families,
         engine=opts.get("engine"),
+        score_budget_s=(max(1, int(deadline_ms)) / 1000.0
+                        if deadline_ms is not None else None),
     )
     return loop.run(int(opts.get("rounds") or 4))

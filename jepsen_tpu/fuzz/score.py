@@ -133,7 +133,8 @@ def coverage_key(score: dict, sched=None) -> str:
 
 
 def score_batch(results: list, spec: SimSpec = DEFAULT_SPEC,
-                scheds=None, engine: str | None = None) -> list:
+                scheds=None, engine: str | None = None,
+                budget: float | None = None) -> list:
     """Score a batch of sim results; one dict per trace:
 
     {"anomaly-types", "cycle-count", "node-count", "component-count",
@@ -144,6 +145,11 @@ def score_batch(results: list, spec: SimSpec = DEFAULT_SPEC,
     tooling). A trace whose inference fails (cannot happen for sim
     traces, but the scorer is also used on foreign fixtures) scores as
     coverage bucket "unknown" rather than poisoning the batch.
+
+    ``budget`` (absolute time.monotonic deadline) bounds the closure
+    launch: traces whose closures didn't fit score "unknown" with
+    error "deadline" while completed traces score normally — the
+    deadline degrades coverage, never correctness.
     """
     graphs: list = [None] * len(results)
     errors: list = [None] * len(results)
@@ -167,10 +173,14 @@ def score_batch(results: list, spec: SimSpec = DEFAULT_SPEC,
                 mats.append(masks[rels][np.ix_(c, c)])
     order = sorted(range(len(mats)), key=lambda i: -mats[i].shape[0])
     closed: list = [None] * len(mats)
-    subs = an_mod._closures([mats[i] for i in order], engine=engine)
+    subs = an_mod._closures([mats[i] for i in order], engine=engine,
+                            budget=budget)
     for i, sub in zip(order, subs):
         closed[i] = sub
-    # reassemble per-trace block-diagonal closures
+    # reassemble per-trace block-diagonal closures; a trace with ANY
+    # deadline-expired (None) block degrades to unknown — an
+    # incomplete closure can only miss anomalies, never find false
+    # ones, so partial blocks must not score
     closures: list = [None] * len(results)
     ji = 0
     for gi, g in enumerate(graphs):
@@ -181,9 +191,15 @@ def score_batch(results: list, spec: SimSpec = DEFAULT_SPEC,
         cl = {rels: np.zeros((n, n), dtype=bool) for rels in _MASK_KEYS}
         for rels in _MASK_KEYS:
             for c in comps:
-                cl[rels][np.ix_(c, c)] = closed[ji]
+                if closed[ji] is None:
+                    cl = None
+                elif cl is not None:
+                    cl[rels][np.ix_(c, c)] = closed[ji]
                 ji += 1
         closures[gi] = cl
+        if cl is None:
+            errors[gi] = "deadline"
+            graphs[gi] = None
     out = []
     for gi, g in enumerate(graphs):
         if g is None:

@@ -15,6 +15,8 @@ each stream's verdicts still bit-identical to one-shot checks
 from __future__ import annotations
 
 import logging
+import random
+import time
 
 from ..history import Op
 
@@ -33,10 +35,17 @@ class QueueStreamClient:
               ops ("register", "cycle", ...)
     window    ops per submission boundary
     weight    the client's weighted-round-robin share
+    backoff_base_s / backoff_cap_s / seed
+              QueueFull handling: a full queue mid-stream is
+              backpressure, not an error — submission retries under
+              capped exponential backoff with seeded jitter, never
+              sleeping less than the queue's retry_after_s hint.
     """
 
     def __init__(self, queue, client: str, workload: str = "register", *,
-                 window: int = 256, weight: int = 1):
+                 window: int = 256, weight: int = 1,
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 30.0, seed: int = 0):
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         self.queue = queue
@@ -44,15 +53,40 @@ class QueueStreamClient:
         self.workload = workload
         self.window = window
         self.weight = weight
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
         self.job_ids: list = []
         self.consumed = 0
+        self.backoffs = 0  # QueueFull rejections absorbed
+        self._rng = random.Random(seed)
 
     def submit_prefix(self, ops) -> str:
-        """Submit one snapshot; returns its durable job id."""
+        """Submit one snapshot; returns its durable job id. A full
+        queue is absorbed here: retry under capped expo backoff
+        (honoring the daemon's retry_after_s hint, jittered UP so a
+        fleet of streams doesn't re-converge on the same instant)
+        rather than surfacing QueueFull mid-stream."""
+        from ..serve.queue import QueueFull
+
         history = [o.to_dict() if isinstance(o, Op) else dict(o)
                    for o in ops]
-        job_id = self.queue.submit(self.client, self.workload, history,
-                                   weight=self.weight)
+        attempt = 0
+        while True:
+            try:
+                job_id = self.queue.submit(self.client, self.workload,
+                                           history, weight=self.weight)
+                break
+            except QueueFull as e:
+                delay = min(self.backoff_cap_s,
+                            max(e.retry_after_s,
+                                self.backoff_base_s * (2 ** attempt)))
+                delay *= 1.0 + 0.5 * self._rng.random()  # [1.0, 1.5)
+                self.backoffs += 1
+                attempt += 1
+                log.warning("queue full (%d pending); stream %s "
+                            "backing off %.2fs (attempt %d)",
+                            e.pending, self.client, delay, attempt)
+                time.sleep(delay)
         self.job_ids.append(job_id)
         return job_id
 

@@ -200,13 +200,17 @@ class StreamSession:
                 }
 
 
-def frontier_for(checker, *, test=None, journal=None):
+def frontier_for(checker, *, test=None, journal=None,
+                 window_budget_s=None):
     """The streaming frontier matching a batch checker, or None when
     the checker has no streaming form. Dispatch mirrors the batch
     composition: a CycleChecker streams through the incremental cycle
     frontier; an IndependentChecker streams through the windowed
     per-key frontier (whatever its sub-checker — P-compositionality is
-    the licence, not the sub-checker's type)."""
+    the licence, not the sub-checker's type). ``window_budget_s``
+    bounds each WGL advance's wall clock (unsupported frontiers ignore
+    it): past the budget the advance commits ``unknown: deadline`` for
+    the keys that didn't fit instead of stalling the stream."""
     from ..checker.cycle import CycleChecker
     from ..independent import IndependentChecker
     from .frontier import CycleFrontier
@@ -215,5 +219,6 @@ def frontier_for(checker, *, test=None, journal=None):
     if isinstance(checker, CycleChecker):
         return CycleFrontier(checker, journal=journal)
     if isinstance(checker, IndependentChecker):
-        return WGLFrontier(checker, test=test, journal=journal)
+        return WGLFrontier(checker, test=test, journal=journal,
+                           window_budget_s=window_budget_s)
     return None

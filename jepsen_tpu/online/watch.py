@@ -59,8 +59,11 @@ def run_watch(opts: dict) -> int:
             None, path=os.path.join(state_dir, MEMO_JOURNAL_FILE))
         verdict_log = VerdictLog(os.path.join(state_dir, VERDICT_LOG_FILE))
 
-    frontier = frontier_for(spec["checker"], test={"name": "watch"},
-                            journal=journal)
+    deadline_ms = opts.get("deadline_ms")
+    frontier = frontier_for(
+        spec["checker"], test={"name": "watch"}, journal=journal,
+        window_budget_s=(max(1, int(deadline_ms)) / 1000.0
+                         if deadline_ms is not None else None))
     if frontier is None:
         raise ValueError(
             f"workload {workload_name!r} has no streaming frontier")

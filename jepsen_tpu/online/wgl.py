@@ -46,10 +46,16 @@ class WGLFrontier:
     test     the test map handed to the sub-checker (model, name, ...)
     journal  optional store.AnalysisJournal to write per-key verdicts
              through to ("independent-key" kind, resume support)
+    window_budget_s
+             optional wall-clock budget per ``advance``: each check
+             runs with ``test["deadline"]`` stamped that far in the
+             future, so the supervisor salvages what fit and fills the
+             rest with ``unknown: deadline`` instead of letting one
+             slow window stall the whole stream
     """
 
     def __init__(self, checker: indep.IndependentChecker, *, test=None,
-                 journal=None):
+                 journal=None, window_budget_s: float | None = None):
         if not isinstance(checker, indep.IndependentChecker):
             raise TypeError(
                 f"WGLFrontier wants an IndependentChecker, got "
@@ -57,6 +63,7 @@ class WGLFrontier:
         self.checker = checker
         self.test = test or {}
         self.journal = journal
+        self.window_budget_s = window_budget_s
         self.ops: list = []
         self._keys: set = set()
         self._dirty: set = set()
@@ -110,7 +117,15 @@ class WGLFrontier:
                           "history_key": k}))
         if todo:
             for (k, _sub, jk, _o), r in zip(todo, self._check(todo)):
-                self._verdicts[k], self._jkeys[k] = r, jk
+                self._verdicts[k] = r
+                if (isinstance(r, dict) and r.get("valid") == "unknown"
+                        and r.get("error") == "deadline"):
+                    # budget expiry is transient: keep the key dirty
+                    # and unmemoized so the next advance retries it
+                    self._dirty.add(k)
+                    self._jkeys.pop(k, None)
+                    continue
+                self._jkeys[k] = jk
                 if self.journal is not None:
                     self.journal.record("independent-key", jk, r)
         self.verdict = indep.combine_results(dict(self._verdicts))
@@ -118,14 +133,21 @@ class WGLFrontier:
 
     def _check(self, todo) -> list:
         """One batched pass over the dirty keys' window — the same
-        batch-else-per-key structure IndependentChecker.check runs."""
+        batch-else-per-key structure IndependentChecker.check runs.
+        A window budget stamps a fresh absolute deadline per pass."""
+        import time as _t
+
+        test = self.test
+        if self.window_budget_s is not None:
+            test = {**test,
+                    "deadline": _t.monotonic() + self.window_budget_s}
         sub_checker = self.checker.checker
         if len(todo) > 1 and hasattr(sub_checker, "check_batch"):
             try:
                 return sub_checker.check_batch(
-                    self.test, [(sub, o) for _, sub, _, o in todo])
+                    test, [(sub, o) for _, sub, _, o in todo])
             except Exception:  # noqa: BLE001 — degrade to per-key path
                 log.warning("batched window check failed; falling back "
                             "to per-key", exc_info=True)
-        return [check_safe(sub_checker, self.test, sub, o)
+        return [check_safe(sub_checker, test, sub, o)
                 for _, sub, _, o in todo]

@@ -32,6 +32,24 @@ SIGTERM drains via core.DrainSignal (the PR-5 machinery): the first
 signal closes admission (submits get 503), lets the worker finish and
 commit its in-flight batch — unanswered specs stay durable for the
 next start — and exits 143; a second SIGTERM force-exits.
+
+Failure containment (the attempt ledger in serve/queue.py):
+
+* every batch charges its jobs one durable attempt BEFORE checking
+  begins, so a history that SIGKILLs the daemon still burns attempts;
+* after a crash, the blamed in-flight jobs are *suspects*: the worker
+  drains the healthy backlog first (bit-identical verdicts — suspects
+  never share a pack with healthy jobs), then re-runs each suspect in
+  a **sacrificial subprocess** (serve/sacrifice.py) under capped
+  exponential backoff, and quarantines it once ``max_attempts`` is
+  spent — an ``unknown: quarantined`` verdict through the normal
+  commit point;
+* a job submitted with ``deadline_ms`` checks with the remaining
+  budget stamped on its test (the supervisor's budget plumbing);
+  expiry commits ``unknown: deadline`` instead of hanging;
+* the worker thread itself is supervised: an uncaught exception is
+  logged, counted, and the loop restarts under backoff — /healthz
+  reports liveness and the last death cause.
 """
 
 from __future__ import annotations
@@ -54,6 +72,12 @@ log = logging.getLogger("jepsen_tpu.serve.daemon")
 #: between batches without patching code)
 BATCH_MAX_ENV = "JEPSEN_TPU_SERVE_BATCH_MAX"
 PACE_ENV = "JEPSEN_TPU_SERVE_PACE_S"
+#: containment knobs
+MAX_ATTEMPTS_ENV = "JEPSEN_TPU_SERVE_MAX_ATTEMPTS"
+SUSPECT_BACKOFF_ENV = "JEPSEN_TPU_SERVE_SUSPECT_BACKOFF_S"
+SUSPECT_TIMEOUT_ENV = "JEPSEN_TPU_SERVE_SUSPECT_TIMEOUT_S"
+SUSPECT_BACKOFF_CAP_S = 30.0
+DEFAULT_SUSPECT_TIMEOUT_S = 600.0
 
 
 def _jsonable(v):
@@ -76,13 +100,40 @@ class VerdictDaemon:
         self.pace_s = float(os.environ.get(PACE_ENV) or pace_s)
         self.draining = threading.Event()
         self.ready = threading.Event()
+        self._worker_lock = threading.Lock()
+        self.worker_deaths = 0
+        self.last_death: dict | None = None
         self._worker = threading.Thread(
-            target=self._run, name="serve verdict worker", daemon=True)
+            target=self._run_guarded, name="serve verdict worker",
+            daemon=True)
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         self._worker.start()
+
+    def worker_state(self) -> dict:
+        """Liveness + death history for /healthz: a daemon whose
+        worker died silently used to accept jobs it would never run."""
+        with self._worker_lock:
+            return {"alive": self._worker.is_alive(),
+                    "deaths": self.worker_deaths,
+                    "last_death": self.last_death}
+
+    def ensure_worker(self) -> None:
+        """Respawn the worker thread if it is outright dead (the guard
+        loop catches Exceptions, so this only fires on the exotic
+        ways a thread dies for real). Called from request handlers —
+        accepting a job implies someone will run it."""
+        with self._worker_lock:
+            if self._worker.is_alive() or self.draining.is_set() \
+                    or not self.ready.is_set():
+                return
+            log.error("verdict worker thread is dead; respawning")
+            self._worker = threading.Thread(
+                target=self._run_guarded, name="serve verdict worker",
+                daemon=True)
+            self._worker.start()
 
     def drain(self) -> bool:
         """First-SIGTERM hook: close admission, let the in-flight
@@ -117,6 +168,106 @@ class VerdictDaemon:
             return independent.pack_check(wl["checker"], test, histories)
         return [check_safe(wl["checker"], test, h) for h in histories]
 
+    def _run_guarded(self) -> None:
+        """The worker thread body: _run() under a crash guard. An
+        uncaught exception is a worker death — logged, counted for
+        /healthz, and the loop restarts under capped backoff instead
+        of leaving a daemon that accepts jobs it will never run."""
+        while True:
+            try:
+                self._run()
+                return  # clean drain exit
+            except Exception as e:  # noqa: BLE001 — anything else is
+                #                     a thread death we must survive
+                with self._worker_lock:
+                    self.worker_deaths += 1
+                    deaths = self.worker_deaths
+                    self.last_death = {
+                        "error": f"{type(e).__name__}: {e}",
+                        "time": time.time()}
+                log.exception("verdict worker died (death #%d); "
+                              "restarting", deaths)
+                if self.draining.is_set():
+                    return
+                time.sleep(min(5.0, 0.1 * (2 ** min(deaths, 6))))
+
+    def _check_deadline_spec(self, spec, remaining: float) -> None:
+        """One deadline'd job, checked individually — NEVER packed (a
+        pack shares one launch; a tight deadline must not drag sibling
+        jobs to unknown) — with the remaining budget stamped on the
+        test, which the linearizable checker threads into
+        Supervisor.call/run as a hard budget. Partial per-key results
+        are salvaged; expiry commits ``unknown: deadline``."""
+        workload = spec["workload"]
+        wl = self.registry.workload(workload)
+        test = {"name": f"serve-{workload}",
+                "deadline": time.monotonic() + remaining}
+        try:
+            h = self._rehydrate(spec)
+            verdict = check_safe(wl["checker"], test, h)
+        except Exception:  # noqa: BLE001
+            log.exception("workload %s deadline job failed", workload)
+            verdict = {"valid": "unknown",
+                       "error": f"workload {workload} failed"}
+        self.queue.commit(spec["id"], _jsonable(verdict))
+
+    def _handle_suspect(self) -> bool:
+        """Run ONE suspect (a job blamed for a previous crash) in a
+        sacrificial subprocess, or quarantine it when its attempts are
+        spent. Returns True when a suspect was handled."""
+        spec = self.queue.take_suspect()
+        if spec is None:
+            return False
+        jid = spec["id"]
+        n = self.queue.attempts_of(jid)
+        if n >= self.queue.max_attempts:
+            self.queue.quarantine(jid)
+            return True
+        # capped exponential backoff on the attempt number: a poison
+        # job must not turn the restart loop into a tight crash loop
+        base = float(os.environ.get(SUSPECT_BACKOFF_ENV) or 1.0)
+        time.sleep(min(SUSPECT_BACKOFF_CAP_S,
+                       base * (2 ** max(0, n - 1))))
+        self.queue.begin_attempts([jid])
+        self._run_sacrificial(spec)
+        if not self.queue.refresh_done(jid) \
+                and self.queue.attempts_of(jid) >= self.queue.max_attempts:
+            self.queue.quarantine(jid)
+        return True
+
+    def _run_sacrificial(self, spec) -> None:
+        """python -m jepsen_tpu.serve.sacrifice <queue> <id>: the
+        subprocess rehydrates and checks the job, committing its
+        verdict straight to the queue directory — a SIGKILL, OOM, or
+        FATAL abort takes the child, not the daemon."""
+        import subprocess
+        import sys
+
+        jid = spec["id"]
+        remaining = self.queue.remaining_s(spec)
+        timeout = float(os.environ.get(SUSPECT_TIMEOUT_ENV)
+                        or DEFAULT_SUSPECT_TIMEOUT_S)
+        if remaining is not None:
+            timeout = min(timeout, max(1.0, remaining))
+        log.warning("running suspect %s in a sacrificial subprocess "
+                    "(attempt %d/%d)", jid, self.queue.attempts_of(jid),
+                    self.queue.max_attempts)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "jepsen_tpu.serve.sacrifice",
+                 self.queue.root, jid],
+                capture_output=True, text=True, timeout=timeout)
+            if proc.returncode != 0:
+                log.warning("sacrificial check of %s died rc=%s: %s",
+                            jid, proc.returncode,
+                            (proc.stderr or "")[-500:])
+        except subprocess.TimeoutExpired:
+            log.warning("sacrificial check of %s timed out after %.1fs",
+                        jid, timeout)
+        except OSError as e:
+            log.warning("sacrificial check of %s failed to launch: %s",
+                        jid, e)
+
     def _run(self) -> None:
         self.ready.set()
         while True:
@@ -126,10 +277,31 @@ class VerdictDaemon:
                 continue
             batch = self.queue.take_batch(self.batch_max)
             if not batch:
+                if self.draining.is_set():
+                    # suspects stay durable (and blamed) for the next
+                    # start; drain must not wait out their backoff
+                    return
+                if not self._handle_suspect():
+                    time.sleep(0.05)
                 continue
+            # the durable attempt ledger: one fsync charges the whole
+            # batch BEFORE checking starts, so an attempt the process
+            # does not survive still counts (and names its suspects)
+            self.queue.begin_attempts([s["id"] for s in batch])
             by_workload: dict = {}
+            now = time.time()
             for spec in batch:
-                by_workload.setdefault(spec["workload"], []).append(spec)
+                remaining = self.queue.remaining_s(spec, now)
+                if remaining is None:
+                    by_workload.setdefault(
+                        spec["workload"], []).append(spec)
+                elif remaining <= 0:
+                    log.warning("job %s deadline expired before "
+                                "checking began", spec["id"])
+                    self.queue.commit(spec["id"], {"valid": "unknown",
+                                                   "error": "deadline"})
+                else:
+                    self._check_deadline_spec(spec, remaining)
             for workload, specs in by_workload.items():
                 try:
                     verdicts = self._check_group(workload, specs)
@@ -194,6 +366,10 @@ class _Handler(BaseHTTPRequestHandler):
             workload = str(spec["workload"])
             history = spec["history"]
             weight = int(spec.get("weight", 1))
+            deadline_ms = spec.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = int(deadline_ms)
+                assert deadline_ms > 0
             assert isinstance(history, list)
         except Exception:  # noqa: BLE001 — malformed submission
             return self._send_json(400, {"error": "bad submission"})
@@ -205,9 +381,11 @@ class _Handler(BaseHTTPRequestHandler):
                       "workloads": d.registry.known_workloads()})
         from .queue import QueueFull
 
+        d.ensure_worker()  # accepting a job implies someone runs it
         try:
             job_id = d.queue.submit(client, workload, history,
-                                    weight=weight)
+                                    weight=weight,
+                                    deadline_ms=deadline_ms)
         except QueueFull as e:
             # bounded-queue backpressure: reject with a retry hint
             # rather than buffering toward OOM
@@ -235,9 +413,16 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/healthz":
             from .registry import EngineRegistry
 
+            d.ensure_worker()
+            worker = d.worker_state()
+            # a drained worker exits on purpose; only an unexpected
+            # death flips liveness
+            ok = worker["alive"] or d.draining.is_set()
             return self._send_json(
-                200, {"ok": True,
-                      "mesh": EngineRegistry.mesh_topology()})
+                200, {"ok": ok,
+                      "mesh": EngineRegistry.mesh_topology(),
+                      "worker": worker,
+                      "quarantined": d.queue.quarantined_ids()})
         if path == "/readyz":
             health = d.registry.health()
             health["draining"] = d.draining.is_set()
@@ -310,9 +495,11 @@ def run_daemon(opts: dict) -> int:
     serve until SIGTERM, drain, exit 143 (or 0 on ctrl-C)."""
     from .. import web
     from .bundle import EngineBundle
-    from .queue import DEFAULT_MAX_PENDING, DurableQueue
-    from .registry import EngineRegistry
+    from .queue import (DEFAULT_MAX_ATTEMPTS, DEFAULT_MAX_PENDING,
+                        DurableQueue)
+    from .registry import EngineRegistry, load_extra_workloads
 
+    load_extra_workloads()
     queue_dir = opts.get("queue_dir") or os.path.join(
         opts.get("store_dir") or store.BASE_DIR, "serve-queue")
     bundle_dir = opts.get("bundle_dir")
@@ -326,9 +513,18 @@ def run_daemon(opts: dict) -> int:
         log.info("engine bundle %s in %.2fs",
                  "warm" if state.get("warm") else "built",
                  state.get("elapsed_s") or 0.0)
+    # Finish jax's import BEFORE the server and worker threads exist:
+    # a /healthz handler importing jax (mesh_topology) concurrently
+    # with the worker's first engine import races jax.numpy's partial
+    # initialization, and the AttributeError is swallowed by engine
+    # eligibility probes — silent routing drift, not a crash.
+    EngineRegistry.mesh_topology()
     queue = DurableQueue(
         queue_dir,
-        max_pending=int(opts.get("max_pending") or DEFAULT_MAX_PENDING))
+        max_pending=int(opts.get("max_pending") or DEFAULT_MAX_PENDING),
+        max_attempts=int(opts.get("max_attempts")
+                         or os.environ.get(MAX_ATTEMPTS_ENV)
+                         or DEFAULT_MAX_ATTEMPTS))
     server, daemon = serve(
         queue, registry, host=opts.get("host") or "127.0.0.1",
         port=int(opts.get("port") or 8181))

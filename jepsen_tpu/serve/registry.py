@@ -67,6 +67,31 @@ WORKLOAD_FACTORIES = {
     "cycle": _cycle_workload,
 }
 
+#: comma-separated module names registering extra workload factories
+#: (imported for their WORKLOAD_FACTORIES side effects); the chaos
+#: tests inject poison/hang workloads this way
+WORKLOADS_ENV = "JEPSEN_TPU_SERVE_WORKLOADS"
+
+
+def load_extra_workloads() -> list:
+    """Import every module named by JEPSEN_TPU_SERVE_WORKLOADS; each
+    registers its factories into WORKLOAD_FACTORIES at import time.
+    Called by the daemon AND the sacrificial subprocess, so a job's
+    workload exists wherever the job runs."""
+    import importlib
+    import os
+
+    mods = []
+    for name in (os.environ.get(WORKLOADS_ENV) or "").split(","):
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            mods.append(importlib.import_module(name))
+        except ImportError:
+            log.exception("cannot import workloads module %s", name)
+    return mods
+
 
 class EngineRegistry:
     """One session's shared engines + workloads + bundle state."""

@@ -1,0 +1,79 @@
+"""Sacrificial execution of one suspected-poison job.
+
+``python -m jepsen_tpu.serve.sacrifice <queue_dir> <job_id>``
+
+The daemon's crash-blame record (serve/queue.py's attempt ledger)
+names the jobs in flight when a previous process died; re-running one
+of those in the daemon itself risks the same death. This module IS the
+containment boundary: it rehydrates and checks exactly one job in a
+fresh process and commits the verdict straight into the queue
+directory with the same atomic-rename discipline, so a SIGKILL, OOM,
+or FATAL XLA abort takes this child and nothing else. The parent
+notices the commit (or its absence) on the disk — the verdict file
+stays the single commit point regardless of which process wrote it.
+
+Deliberately NOT a DurableQueue client: opening the queue would run
+recovery, and recovery quarantines unanswered jobs whose attempts are
+spent — including the very attempt this process is here to make.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+log = logging.getLogger("jepsen_tpu.serve.sacrifice")
+
+
+def run_one(queue_dir: str, job_id: str) -> int:
+    from .. import store
+    from ..checker import check_safe
+    from .daemon import _jsonable
+    from .queue import JOBS_DIR, VERDICTS_DIR, DurableQueue
+    from .registry import EngineRegistry, load_extra_workloads
+
+    load_extra_workloads()
+    spec = store.read_json_dict(
+        os.path.join(queue_dir, JOBS_DIR, job_id + ".json"))
+    if spec is None:
+        log.error("no readable spec for %s", job_id)
+        return 2
+    verdict_path = os.path.join(queue_dir, VERDICTS_DIR,
+                                job_id + ".json")
+    if os.path.exists(verdict_path):
+        return 0  # already committed by someone; nothing to do
+    registry = EngineRegistry()
+    wl = registry.workload(spec["workload"])
+    test: dict = {"name": f"serve-{spec['workload']}"}
+    remaining = DurableQueue.remaining_s(spec)
+    verdict = None
+    if remaining is not None:
+        if remaining <= 0:
+            verdict = {"valid": "unknown", "error": "deadline"}
+        else:
+            test["deadline"] = time.monotonic() + remaining
+    if verdict is None:
+        from ..history import Op, index as index_history
+
+        ops = [Op.from_dict(d) for d in spec["history"]]
+        if wl["rehydrate"] is not None:
+            ops = [wl["rehydrate"](o) for o in ops]
+        verdict = check_safe(wl["checker"], test, index_history(ops))
+    store.atomic_write_json(verdict_path,
+                            {"id": job_id, "verdict": _jsonable(verdict)})
+    return 0
+
+
+def main(argv: list) -> int:
+    if len(argv) != 2:
+        print("usage: python -m jepsen_tpu.serve.sacrifice "
+              "<queue_dir> <job_id>", file=sys.stderr)
+        return 2
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    return run_one(argv[0], argv[1])
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -140,6 +140,19 @@ def atomic_write_json(p: str, value, rotate_prev: bool = False) -> str:
     return p
 
 
+def read_json_dict(p: str) -> dict | None:
+    """Best-effort read-back of an atomic_write_json file: the dict, or
+    None for missing/torn/non-dict content. The serve queue, the
+    sacrificial runner, and replay tooling all want the same 'a disk
+    that lies must not wedge us' posture, so it lives here."""
+    try:
+        with open(p) as f:
+            v = json.load(f)
+        return v if isinstance(v, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
 def _json_keys(v):
     """json's default= hook never applies to dict KEYS — independent-
     checker results are keyed by arbitrary workload keys (e.g. tuples),

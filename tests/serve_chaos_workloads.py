@@ -1,0 +1,62 @@
+"""Chaos workloads for the failure-containment e2e
+(tests/test_serve_chaos.py), injected into the daemon AND the
+sacrificial subprocess through JEPSEN_TPU_SERVE_WORKLOADS (the serve
+registry imports this module at startup; importing registers the
+factories).
+
+poison  a checker that SIGKILLs its own process the moment it runs —
+        the worst-case poison job: no exception to catch, no cleanup,
+        the attempt ledger is the only evidence it ever started
+hang    the register workload with the supervisor's WGL search forced
+        through a permanently-hanging engine rung (testlib.FlakyEngine)
+        so only deadline propagation can produce a verdict
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+from jepsen_tpu.serve.registry import (WORKLOAD_FACTORIES,
+                                       _register_workload)
+
+
+class _PoisonChecker:
+    def check(self, test, history, opts=None):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _poison_workload() -> dict:
+    return {"checker": _PoisonChecker(), "rehydrate": None,
+            "packable": False}
+
+
+def _hang_workload() -> dict:
+    import importlib
+
+    # checker/__init__ re-exports a FUNCTION named `linearizable`,
+    # shadowing the submodule as a package attribute
+    lin_mod = importlib.import_module("jepsen_tpu.checker.linearizable")
+    from jepsen_tpu.checker import supervisor as sup_mod
+    from jepsen_tpu.checker.supervisor import _run_host
+    from jepsen_tpu.independent import checker as indep_checker
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.testlib import FlakyEngine
+
+    sup = sup_mod.get()
+    if "flaky_hang" not in sup.registry:
+        # every call hangs well past any test deadline — but short
+        # enough that the watchdog-abandoned thread finishes inside
+        # the supervisor's bounded atexit drain, so SIGTERM still
+        # exits promptly
+        sup.registry["flaky_hang"] = FlakyEngine(
+            _run_host, schedule=["hang"] * 10_000, hang_s=15.0)
+        lin_mod._LADDERS["flaky_hang"] = ("flaky_hang",)
+    return {"checker": indep_checker(lin_mod.Linearizable(
+                CASRegister(None), algorithm="flaky_hang")),
+            "rehydrate": _register_workload()["rehydrate"],
+            "packable": False}
+
+
+WORKLOAD_FACTORIES.setdefault("poison", _poison_workload)
+WORKLOAD_FACTORIES.setdefault("hang", _hang_workload)

@@ -1,14 +1,17 @@
 """Subprocess driver for the serve-daemon chaos e2e
 (tests/test_serve_chaos.py). Runnable as a subprocess:
 
-    python -m tests.serve_driver <queue-dir> <port>
+    python -m tests.serve_driver <queue-dir> <port> [max-attempts]
 
 Runs the resident verdict daemon against a test-owned queue directory
 with the AOT bundle disabled (the e2e measures queue durability, not
 compile warmth). The test controls worker pacing through the daemon's
-env knobs (JEPSEN_TPU_SERVE_PACE_S / _BATCH_MAX) so it can SIGKILL the
-process mid-queue deterministically: some verdicts committed, some
-specs still pending. On SIGTERM the daemon drains and exits 143."""
+env knobs (JEPSEN_TPU_SERVE_PACE_S / _BATCH_MAX), injects chaos
+workloads through JEPSEN_TPU_SERVE_WORKLOADS, and bounds the
+poison-job crash loop with the optional max-attempts argument, so it
+can SIGKILL the process mid-queue deterministically: some verdicts
+committed, some specs still pending. On SIGTERM the daemon drains and
+exits 143."""
 
 from __future__ import annotations
 
@@ -22,8 +25,11 @@ def main(argv) -> int:
     queue_dir, port = argv[0], int(argv[1])
     logging.basicConfig(level=logging.INFO,
                         format="%(name)s %(message)s", stream=sys.stderr)
-    return run_daemon({"queue_dir": queue_dir, "port": port,
-                       "host": "127.0.0.1", "bundle_dir": "off"})
+    opts = {"queue_dir": queue_dir, "port": port,
+            "host": "127.0.0.1", "bundle_dir": "off"}
+    if len(argv) > 2:
+        opts["max_attempts"] = int(argv[2])
+    return run_daemon(opts)
 
 
 if __name__ == "__main__":

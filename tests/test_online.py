@@ -240,9 +240,9 @@ def test_cycle_frontier_reuses_clean_component_closures(monkeypatch):
     sizes = []
     real = anomalies_mod._closures
 
-    def counting(mats, engine=None):
+    def counting(mats, engine=None, budget=None):
         sizes.append(len(mats))
-        return real(mats, engine=engine)
+        return real(mats, engine=engine, budget=budget)
 
     monkeypatch.setattr(anomalies_mod, "_closures", counting)
     f = CycleFrontier(cycle.checker(engine="host"))
@@ -529,3 +529,52 @@ def test_queue_stream_client_packs_windows(tmp_path):
     assert strip_supervision(final) == strip_supervision(one_shot_json)
     assert final["valid"] is False
     assert final["failures"] == [1]
+
+
+def test_queue_stream_client_absorbs_queue_full(tmp_path, monkeypatch):
+    from jepsen_tpu.online import client as client_mod
+    from jepsen_tpu.serve.queue import QueueFull
+
+    class RejectingQueue:
+        """Rejects the first `rejections` submits with a full-queue
+        hint, then accepts."""
+
+        def __init__(self, rejections):
+            self.left = rejections
+            self.submits = 0
+
+        def submit(self, client, workload, history, weight=1, **kw):
+            if self.left > 0:
+                self.left -= 1
+                raise QueueFull(pending=256, retry_after_s=2.0)
+            self.submits += 1
+            return f"job-{self.submits}"
+
+    slept: list[float] = []
+    monkeypatch.setattr(client_mod.time, "sleep", slept.append)
+
+    q = RejectingQueue(rejections=3)
+    c = client_mod.QueueStreamClient(
+        q, "stream-a", window=4, backoff_base_s=0.5,
+        backoff_cap_s=8.0, seed=7)
+    jid = c.submit_prefix([{"process": 0, "type": "invoke", "f": "read",
+                            "value": None, "time": 0}])
+    assert jid == "job-1"  # backpressure absorbed, never surfaced
+    assert c.backoffs == 3
+    assert len(slept) == 3
+    # every sleep honors the queue's retry_after_s hint, jittered UP
+    # (so a fleet of streams doesn't re-converge on the same instant)
+    # and capped at backoff_cap_s before jitter
+    for i, d in enumerate(slept):
+        base = min(8.0, max(2.0, 0.5 * (2 ** i)))
+        assert base <= d < base * 1.5
+    # seeded jitter: a client with the same seed backs off identically
+    q2 = RejectingQueue(rejections=3)
+    slept2: list[float] = []
+    monkeypatch.setattr(client_mod.time, "sleep", slept2.append)
+    c2 = client_mod.QueueStreamClient(
+        q2, "stream-b", window=4, backoff_base_s=0.5,
+        backoff_cap_s=8.0, seed=7)
+    c2.submit_prefix([{"process": 0, "type": "invoke", "f": "read",
+                      "value": None, "time": 0}])
+    assert slept2 == slept

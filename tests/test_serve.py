@@ -130,6 +130,77 @@ class TestDurableQueue:
         t.join()
 
 
+class TestAttemptLedger:
+    def test_attempts_charged_durably_before_execution(self, tmp_path):
+        root = str(tmp_path / "q")
+        q = DurableQueue(root, max_attempts=3)
+        jid = q.submit("a", "register", _register_history())
+        q.begin_attempts([jid])
+        # a brand-new instance (the post-SIGKILL restart) sees the
+        # charge AND blames the in-flight job as a suspect
+        q2 = DurableQueue(root, max_attempts=3)
+        assert q2.attempts_of(jid) == 1
+        assert q2.suspect_ids() == [jid]
+        # suspects never ride a healthy batch
+        assert q2.take_batch() == []
+        assert q2.take_suspect()["id"] == jid
+
+    def test_recovery_dead_letters_at_max_attempts(self, tmp_path):
+        root = str(tmp_path / "q")
+        q = DurableQueue(root, max_attempts=2)
+        jid = q.submit("a", "register", _register_history())
+        ok = q.submit("b", "register", _register_history("y"))
+        q.begin_attempts([jid])
+        q2 = DurableQueue(root, max_attempts=2)
+        q2.begin_attempts([jid])
+        # attempts are spent; the NEXT recovery quarantines
+        q3 = DurableQueue(root, max_attempts=2)
+        assert q3.verdict(jid) == {"valid": "unknown",
+                                   "error": "quarantined"}
+        assert q3.quarantined_ids() == [jid]
+        assert q3.suspect_ids() == []
+        # the healthy sibling is untouched and schedulable
+        assert [s["id"] for s in q3.take_batch()] == [ok]
+
+    def test_commit_clears_suspicion(self, tmp_path):
+        root = str(tmp_path / "q")
+        q = DurableQueue(root)
+        jid = q.submit("a", "register", [])
+        q.begin_attempts([jid])
+        q2 = DurableQueue(root)
+        assert q2.suspect_ids() == [jid]
+        q2.commit(jid, {"valid": True})
+        assert q2.suspect_ids() == []
+        # and the verdict wins over any later quarantine pressure
+        q3 = DurableQueue(root, max_attempts=1)
+        assert q3.verdict(jid) == {"valid": True}
+
+    def test_refresh_done_absorbs_foreign_commit(self, tmp_path):
+        root = str(tmp_path / "q")
+        q = DurableQueue(root)
+        jid = q.submit("a", "register", [])
+        assert q.refresh_done(jid) is False
+        # another process (the sacrificial subprocess) commits via its
+        # own handle; this instance notices on refresh
+        other = DurableQueue(root)
+        other.commit(jid, {"valid": True})
+        assert q.refresh_done(jid) is True
+        assert q.verdict(jid) == {"valid": True}
+
+    def test_deadline_ms_anchored_at_submission(self, tmp_path):
+        q = DurableQueue(str(tmp_path / "q"))
+        jid = q.submit("a", "register", [], deadline_ms=5000)
+        spec = q.take_batch()[0]
+        assert spec["id"] == jid
+        r = DurableQueue.remaining_s(spec)
+        assert 0 < r <= 5.0
+        # restart-safe: the anchor is wall time in the spec itself
+        spec2 = DurableQueue(str(tmp_path / "q")).take_batch()[0]
+        assert abs(DurableQueue.remaining_s(spec2) - r) < 1.0
+        assert DurableQueue.remaining_s(
+            {"deadline_ms": None}) is None
+
+
 class TestBundleStaleness:
     @pytest.fixture
     def quiet_bundle(self, tmp_path, monkeypatch):
@@ -339,7 +410,14 @@ class TestDaemonHTTP:
 
     def test_health_ready_stats(self, served):
         base, _q, dm = served
-        assert self._get(base + "/healthz") == (200, {"ok": True})
+        code, health = self._get(base + "/healthz")
+        assert code == 200
+        assert health["ok"] is True
+        assert health["worker"]["alive"] is True
+        assert health["worker"]["deaths"] == 0
+        assert health["worker"]["last_death"] is None
+        assert health["quarantined"] == []
+        assert set(health["mesh"]) >= {"devices", "platform"}
         code, ready = self._get(base + "/readyz")
         assert code == 200
         assert ready["bundle"] == {"present": False, "warm": False,
@@ -387,3 +465,45 @@ class TestDaemonHTTP:
         finally:
             dm.draining.set()
             server.shutdown()
+
+    def test_worker_death_is_detected_and_survived(self, served):
+        base, q, dm = served
+        real = q.take_batch
+        tripped = threading.Event()
+
+        def boom(*a, **kw):
+            if not tripped.is_set():
+                tripped.set()
+                raise RuntimeError("injected worker death")
+            return real(*a, **kw)
+
+        q.take_batch = boom
+        # the submit wakes the worker into the injected crash; the
+        # guard loop records the cause, backs off, and keeps serving
+        code, body = self._post(base + "/submit", {
+            "client": "c1", "workload": "register",
+            "history": _register_history()})
+        assert code == 200
+        code, v = self._get(base + f"/verdict/{body['id']}?wait=120")
+        assert code == 200
+        assert v["verdict"]["valid"] is True
+        code, health = self._get(base + "/healthz")
+        assert code == 200
+        assert health["ok"] is True
+        assert health["worker"]["alive"] is True
+        assert health["worker"]["deaths"] == 1
+        assert ("injected worker death"
+                in health["worker"]["last_death"]["error"])
+
+    def test_deadline_expired_before_start_commits_unknown(self, served):
+        base, q, _dm = served
+        code, body = self._post(base + "/submit", {
+            "client": "c1", "workload": "register",
+            "history": _register_history(), "deadline_ms": 1})
+        assert code == 200
+        # 1ms is gone before the worker can even take the batch; the
+        # daemon must still commit SOME verdict, not strand the job
+        code, v = self._get(base + f"/verdict/{body['id']}?wait=120")
+        assert code == 200
+        assert v["verdict"]["valid"] == "unknown"
+        assert "deadline" in json.dumps(v["verdict"])

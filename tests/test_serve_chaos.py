@@ -2,8 +2,10 @@
 SIGKILL the daemon mid-queue, restart it over the same queue
 directory, and require every submitted history to get EXACTLY one
 verdict, bit-identical to checking the same history one-shot. Plus the
-serve-subcommand signal contract: SIGTERM drains and exits 143 in both
-web-UI and daemon modes."""
+failure-containment e2e (poison-job quarantine after max_attempts;
+deadline_ms jobs committing within budget + one watchdog period) and
+the serve-subcommand signal contract: SIGTERM drains and exits 143 in
+both web-UI and daemon modes."""
 
 from __future__ import annotations
 
@@ -54,14 +56,23 @@ def _wait_http(url: str, timeout_s: float) -> None:
             time.sleep(0.05)
 
 
-def _submit(port: int, client: str, history: list) -> str:
+def _submit(port: int, client: str, history: list,
+            workload: str = "register", deadline_ms=None) -> str:
+    spec = {"client": client, "workload": workload, "history": history}
+    if deadline_ms is not None:
+        spec["deadline_ms"] = deadline_ms
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/submit",
-        data=json.dumps({"client": client, "workload": "register",
-                         "history": history}).encode(),
+        data=json.dumps(spec).encode(),
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=30) as r:
         return json.loads(r.read())["id"]
+
+
+def _get_json(port: int, path: str, timeout: float = 30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return json.loads(r.read())
 
 
 def _register_history(k: str, good: bool) -> list:
@@ -186,6 +197,160 @@ class TestServeChaos:
             daemon_v = _strip(rec["verdict"])
             assert daemon_v["valid"] is good
             assert daemon_v == _strip(_one_shot_verdict(hist))
+
+
+class TestFailureContainment:
+    """The containment e2e: a poison job (its check SIGKILLs the
+    process) is quarantined after exactly max_attempts charged
+    attempts — one daemon death, one sacrificial subprocess death —
+    while healthy jobs queued beside it get verdicts bit-identical to
+    one-shot runs; a deadline_ms job gets SOME committed verdict
+    within its budget plus one watchdog period, even when its engine
+    hangs forever."""
+
+    CHAOS_ENV = dict(
+        JEPSEN_TPU_SERVE_BATCH_MAX="1",
+        JEPSEN_TPU_SERVE_WORKLOADS="tests.serve_chaos_workloads",
+        JEPSEN_TPU_SERVE_SUSPECT_BACKOFF_S="0.1",
+        JEPSEN_TPU_SERVE_SUSPECT_TIMEOUT_S="120",
+        JEPSEN_TPU_SUP_GRACE="0.5",
+    )
+
+    def test_poison_job_quarantined_after_max_attempts(self, tmp_path):
+        queue_dir = str(tmp_path / "queue")
+        env = _env(**self.CHAOS_ENV)
+        max_attempts = 2
+
+        # start 1: the poison job's check SIGKILLs the daemon — but
+        # its attempt was fsynced BEFORE the check ran
+        port = _free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tests.serve_driver", queue_dir,
+             str(port), str(max_attempts)],
+            cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_http(f"http://127.0.0.1:{port}/healthz", 90)
+            poison_id = _submit(port, "evil", [], workload="poison")
+            # the daemon dies BY SIGKILLING ITSELF mid-check
+            assert proc.wait(timeout=120) == -signal.SIGKILL
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+        # start 2: recovery blames the poison job (in-flight at the
+        # crash, attempts=1). Healthy jobs flow around it; the suspect
+        # re-runs sacrificially (attempt 2, the subprocess dies), and
+        # the job dead-letters with a committed unknown verdict. The
+        # daemon itself survives.
+        port2 = _free_port()
+        proc2 = subprocess.Popen(
+            [sys.executable, "-m", "tests.serve_driver", queue_dir,
+             str(port2), str(max_attempts)],
+            cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_http(f"http://127.0.0.1:{port2}/healthz", 90)
+            histories = [_register_history(f"k{i}", good)
+                         for i, good in enumerate(VALIDITY)]
+            ids = [_submit(port2, f"client-{i % 2}", h)
+                   for i, h in enumerate(histories)]
+
+            deadline = time.monotonic() + 240
+            want = set(ids) | {poison_id}
+            verdicts_dir = os.path.join(queue_dir, "verdicts")
+            while True:
+                done = {f[:-5] for f in os.listdir(verdicts_dir)
+                        if f.endswith(".json")}
+                if done >= want:
+                    break
+                assert proc2.poll() is None, \
+                    "daemon died again — the sacrifice boundary leaked"
+                assert time.monotonic() < deadline, \
+                    f"containment incomplete: {len(done)}/{len(want)}"
+                time.sleep(0.1)
+
+            # the daemon survived the whole quarantine
+            assert proc2.poll() is None
+            # the poison verdict is the dead-letter marker, served
+            # through the normal verdict API
+            rec = _get_json(port2, f"/verdict/{poison_id}")
+            assert rec["verdict"] == {"valid": "unknown",
+                                      "error": "quarantined"}
+            # exactly max_attempts were charged, and surfaced
+            health = _get_json(port2, "/healthz")
+            assert health["quarantined"] == [poison_id]
+            stats = _get_json(port2, "/stats")
+            assert stats["quarantined"] == [poison_id]
+            assert stats["max_attempts"] == max_attempts
+
+            # healthy siblings: bit-identical to one-shot checks
+            for jid, hist, good in zip(ids, histories, VALIDITY):
+                with open(os.path.join(verdicts_dir,
+                                       jid + ".json")) as f:
+                    rec = json.load(f)
+                daemon_v = _strip(rec["verdict"])
+                assert daemon_v["valid"] is good
+                assert daemon_v == _strip(_one_shot_verdict(hist))
+
+            proc2.terminate()
+            assert proc2.wait(timeout=90) == 143
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+                proc2.wait(timeout=30)
+
+    def test_deadline_ms_commits_within_budget(self, tmp_path):
+        queue_dir = str(tmp_path / "queue")
+        env = _env(**self.CHAOS_ENV)
+        port = _free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tests.serve_driver", queue_dir,
+             str(port)],
+            cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_http(f"http://127.0.0.1:{port}/healthz", 90)
+
+            # (a) hang-injected engine: the ONLY way this job gets a
+            # verdict is deadline propagation cutting the hang off
+            d_ms = 1500
+            hist = _register_history("hk", True)
+            t0 = time.monotonic()
+            jid = _submit(port, "c-hang", hist, workload="hang",
+                          deadline_ms=d_ms)
+            rec = _get_json(port, f"/verdict/{jid}?wait=60",
+                            timeout=90)
+            elapsed = time.monotonic() - t0
+            assert rec["verdict"]["valid"] == "unknown"
+            assert "deadline" in json.dumps(rec["verdict"])
+            # budget + one watchdog period (grace=0.5s) + scheduler
+            # slack; the point is it's seconds, not the engine's
+            # 3600s hang
+            assert elapsed < d_ms / 1000.0 + 0.5 + 20.0
+
+            # (b) oversized history: many keys under a real budget —
+            # some committed verdict arrives within the same bound
+            # (partial per-key salvage makes unknowns, finished keys
+            # keep real verdicts; either way it commits on time)
+            big = []
+            for k in range(40):
+                big.extend(_register_history(f"big{k}", True))
+            t0 = time.monotonic()
+            jid2 = _submit(port, "c-big", big, deadline_ms=d_ms)
+            rec2 = _get_json(port, f"/verdict/{jid2}?wait=60",
+                             timeout=90)
+            elapsed2 = time.monotonic() - t0
+            assert rec2["verdict"]["valid"] in (True, "unknown")
+            assert elapsed2 < d_ms / 1000.0 + 0.5 + 20.0
+
+            proc.terminate()
+            assert proc.wait(timeout=90) == 143
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
 
 
 class TestServeSignalContract:

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 
 import pytest
 
@@ -105,6 +106,134 @@ class TestCircuitBreaker:
         br.record_failure("e")
         br.record_success("e")
         assert br.record_failure("e") is False  # streak restarted
+
+
+class TestHalfOpenProbeRace:
+    def test_exactly_one_thread_wins_the_probe_slot(self):
+        t = [0.0]
+        br = sup_mod.CircuitBreaker(1, 10.0, clock=lambda: t[0])
+        assert br.record_failure("e") is True  # tripped
+        t[0] = 10.0  # cool-down elapsed: half-open
+        barrier = threading.Barrier(2)
+        wins: list[bool] = []
+        lock = threading.Lock()
+
+        def probe():
+            barrier.wait()
+            got = br.healthy("e")
+            with lock:
+                wins.append(got)
+
+        threads = [threading.Thread(target=probe) for _ in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert sorted(wins) == [False, True]
+
+    def test_claimant_may_reconsult_its_own_claim(self):
+        # retry loops re-check healthy() between attempts; the probe
+        # winner must not lock ITSELF out
+        t = [0.0]
+        br = sup_mod.CircuitBreaker(1, 10.0, clock=lambda: t[0])
+        br.record_failure("e")
+        t[0] = 10.0
+        assert br.healthy("e") is True
+        assert br.healthy("e") is True
+
+    def test_failed_probe_retrips_for_a_full_cooldown(self):
+        t = [0.0]
+        br = sup_mod.CircuitBreaker(1, 10.0, clock=lambda: t[0])
+        br.record_failure("e")
+        t[0] = 10.0
+        assert br.healthy("e")
+        assert br.record_failure("e") is True  # probe failed: re-trip
+        assert not br.healthy("e")
+        t[0] = 19.9
+        assert not br.healthy("e")  # fresh full cool-down
+        t[0] = 20.0
+        assert br.healthy("e")
+
+    def test_stale_claim_expires_and_is_reclaimable(self):
+        t = [0.0]
+        br = sup_mod.CircuitBreaker(1, 10.0, clock=lambda: t[0])
+        br.record_failure("e")
+        t[0] = 10.0
+        won: list[bool] = []
+        th = threading.Thread(target=lambda: won.append(br.healthy("e")))
+        th.start()
+        th.join()
+        assert won == [True]
+        # the claimant thread died mid-probe without resolving it:
+        # other threads stay locked out only until the claim expires
+        assert br.healthy("e") is False
+        t[0] = 20.0
+        assert br.healthy("e") is True
+
+    def test_probe_success_fully_closes(self):
+        t = [0.0]
+        br = sup_mod.CircuitBreaker(1, 10.0, clock=lambda: t[0])
+        br.record_failure("e")
+        t[0] = 10.0
+        assert br.healthy("e")
+        br.record_success("e")
+        # closed for everyone, claim slot released
+        won: list[bool] = []
+        th = threading.Thread(target=lambda: won.append(br.healthy("e")))
+        th.start()
+        th.join()
+        assert won == [True]
+
+
+class TestBudget:
+    def test_call_with_expired_budget_raises_deadline(self):
+        sup = supervisor({"pallas": host_batch})
+        with pytest.raises(sup_mod.EngineFailure) as ei:
+            sup.call("pallas", MODEL, [make_entries(_history())],
+                     budget=time.monotonic() - 1.0)
+        assert ei.value.kind == "deadline"
+        assert sup.telemetry.snapshot()["deadline_expired"] == 1
+        # a budget expiry is the CLIENT's fault, not the engine's
+        assert sup.healthy("pallas")
+
+    def test_run_fills_expired_lanes_without_raising(self):
+        sup = supervisor({"host": host_batch})
+        ess = [make_entries(_history()) for _ in range(3)]
+        out = sup.run(MODEL, ess, ladder=("host",),
+                      budget=time.monotonic() - 1.0,
+                      on_exhausted="raise")
+        assert [r.valid for r in out] == ["unknown"] * 3
+        assert all(r.error == "deadline" for r in out)
+        assert sup.telemetry.snapshot()["deadline_expired"] >= 1
+
+    def test_run_salvages_completed_chunks_midway(self):
+        # chunk_lanes=2 -> chunks [0,1] and [2,3]; the first chunk's
+        # engine call burns the rest of the budget, so the second must
+        # resolve unknown/deadline while the first keeps its verdicts
+        budget = time.monotonic() + 0.2
+
+        def slow(model, ess, max_steps=None, time_limit=None):
+            rs = host_batch(model, ess)
+            while time.monotonic() < budget:
+                time.sleep(0.01)
+            return rs
+
+        sup = supervisor({"host": slow})
+        ess = [make_entries(_history()) for _ in range(4)]
+        out = sup.run(MODEL, ess, ladder=("host",), budget=budget)
+        assert [r.valid for r in out[:2]] == [True, True]
+        assert [r.valid for r in out[2:]] == ["unknown"] * 2
+        assert all(r.error == "deadline" for r in out[2:])
+
+    def test_expired_fill_override(self):
+        # the closure ladder cannot fake matrix results; it passes
+        # expired_fill=lambda: None and handles the holes itself
+        sup = supervisor({"host": host_batch})
+        out = sup.run(MODEL, [make_entries(_history())],
+                      ladder=("host",),
+                      budget=time.monotonic() - 1.0,
+                      expired_fill=lambda: None)
+        assert out == [None]
 
 
 class TestCall:

@@ -56,6 +56,14 @@ committed to tests/fixtures/fuzz_anomalies.jsonl re-simulates from its
 decoded history must reproduce the recorded anomaly classes through
 the standard cycle-checker path on both closure engines.
 
+Failure containment replays too (the "containment" block): the serve
+layer's durable attempt ledger dead-letters a simulated poison job
+after exactly max_attempts crash-loop recoveries with the canonical
+`unknown: quarantined` verdict, a healthy sibling checked by a live
+in-process daemon stays bit-identical to a one-shot check, and a job
+with an already-spent deadline_ms still gets a committed `unknown:
+deadline` verdict instead of a stranded spec.
+
 Usage:  python tools/replay_parity.py  [--out PATH]
 """
 
@@ -742,6 +750,114 @@ def replay_fuzz() -> dict:
     return out
 
 
+def replay_containment() -> dict:
+    """Failure-containment parity (ISSUE 20): the serve layer's
+    attempt ledger must dead-letter a poison job after EXACTLY
+    max_attempts charged attempts — replayed here as begin_attempts
+    followed by dropping the queue instance, the on-disk shape a
+    SIGKILLed daemon leaves behind — committing the canonical
+    `unknown: quarantined` verdict; a healthy sibling queued beside the
+    poison must flow through a live in-process daemon to a verdict
+    bit-identical to a one-shot check; and a job whose deadline_ms is
+    already spent must still get SOME committed verdict (tagged
+    deadline), never a stranded spec."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu.checker import check_safe
+    from jepsen_tpu.history import Op, index as index_history
+    from jepsen_tpu.serve import DurableQueue, EngineRegistry
+    from jepsen_tpu.serve import daemon as daemon_mod
+    from jepsen_tpu.serve.queue import QUARANTINED_VERDICT
+    from jepsen_tpu.serve.registry import _register_workload
+
+    t0 = time.monotonic()
+    out: dict = {"max_attempts": 2, "quarantine_attempts": 0,
+                 "quarantine_ok": False, "healthy_bitidentical": False,
+                 "deadline_ok": False, "failures": 0}
+
+    hist = [
+        {"process": 0, "type": "invoke", "f": "write", "value": ["x", 1],
+         "time": 0},
+        {"process": 0, "type": "ok", "f": "write", "value": ["x", 1],
+         "time": 1},
+        {"process": 1, "type": "invoke", "f": "read", "value": ["x", None],
+         "time": 2},
+        {"process": 1, "type": "ok", "f": "read", "value": ["x", 1],
+         "time": 3},
+    ]
+
+    tmp = tempfile.mkdtemp(prefix="replay-containment-")
+    try:
+        # crash-loop quarantine through ledger recovery alone: charge
+        # an attempt, then "SIGKILL" (drop the instance) and recover
+        # from disk — the verdict must land after exactly max_attempts
+        try:
+            max_attempts = out["max_attempts"]
+            root = os.path.join(tmp, "q-poison")
+            q = DurableQueue(root, max_attempts=max_attempts)
+            poison = q.submit("client-a", "register", hist)
+            ok_sib = q.submit("client-b", "register", hist)
+            attempts = 0
+            while q.verdict(poison) is None and attempts < max_attempts + 2:
+                q.begin_attempts([poison])
+                attempts += 1
+                q = DurableQueue(root, max_attempts=max_attempts)
+            out["quarantine_attempts"] = attempts
+            out["quarantine_ok"] = (
+                attempts == max_attempts
+                and q.verdict(poison) == dict(QUARANTINED_VERDICT)
+                and q.quarantined_ids() == [poison]
+                # the healthy sibling never rode the crash loop and is
+                # still schedulable after every recovery
+                and [s["id"] for s in q.take_batch()] == [ok_sib])
+            if not out["quarantine_ok"]:
+                log(f"  containment: quarantine drifted (attempts="
+                    f"{attempts}, verdict={q.verdict(poison)})")
+        except Exception as e:  # noqa: BLE001 — counted, not fatal
+            out["failures"] += 1
+            log(f"  containment: quarantine replay failed ({e!r}); counted")
+
+        # a live in-process daemon: healthy verdicts bit-identical to
+        # one-shot, pre-expired deadlines committed rather than stranded
+        try:
+            q2 = DurableQueue(os.path.join(tmp, "q-daemon"))
+            server, dm = daemon_mod.serve(q2, EngineRegistry(None), port=0)
+            try:
+                ok_id = q2.submit("client-a", "register", hist)
+                late_id = q2.submit("client-a", "register", hist,
+                                    deadline_ms=1)
+                v_ok = q2.wait_for_verdict(ok_id, timeout=120)
+                v_late = q2.wait_for_verdict(late_id, timeout=120)
+            finally:
+                dm.draining.set()
+                server.shutdown()
+            wl = _register_workload()
+            ops = [wl["rehydrate"](Op.from_dict(d)) for d in hist]
+            one_shot = daemon_mod._jsonable(check_safe(
+                wl["checker"], {"name": "serve-register"},
+                index_history(ops)))
+            out["healthy_bitidentical"] = (
+                _strip_supervision(v_ok) == _strip_supervision(one_shot))
+            if not out["healthy_bitidentical"]:
+                log("  containment: healthy verdict drifted from one-shot")
+            out["deadline_ok"] = (
+                isinstance(v_late, dict)
+                and v_late.get("valid") == "unknown"
+                and "deadline" in json.dumps(v_late))
+            if not out["deadline_ok"]:
+                log(f"  containment: deadline verdict drifted ({v_late})")
+        except Exception as e:  # noqa: BLE001
+            out["failures"] += 1
+            log(f"  containment: daemon replay failed ({e!r}); counted")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    out["ok"] = (out["quarantine_ok"] and out["healthy_bitidentical"]
+                 and out["deadline_ok"] and not out["failures"])
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=os.path.join(ROOT, "PARITY.json"),
@@ -807,9 +923,14 @@ def main(argv=None) -> int:
     online_out = replay_online()
     log(f"  online: {online_out}")
 
+    log("replaying failure containment ...")
+    containment_out = replay_containment()
+    log(f"  containment: {containment_out}")
+
     ok = (all(not e.get("mismatches") for e in engines.values())
           and cycle_out["ok"] and mesh_out["ok"] and resume_out["ok"]
-          and fuzz_out["ok"] and online_out["ok"])
+          and fuzz_out["ok"] and online_out["ok"]
+          and containment_out["ok"])
     # supervision telemetry (per-engine failure kinds, demotions,
     # breaker trips) for any checks that routed through the supervisor
     # during the replay — zeros on a healthy run
@@ -830,6 +951,7 @@ def main(argv=None) -> int:
         "resume": resume_out,
         "fuzz": fuzz_out,
         "online": online_out,
+        "containment": containment_out,
         "supervision": supervision,
         "ok": ok,
     }
